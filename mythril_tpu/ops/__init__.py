@@ -1,0 +1,3 @@
+"""Device-side op kernels: u256 limb arithmetic, keccak, opcode semantics."""
+
+from mythril_tpu.ops import u256  # noqa: F401

@@ -1,0 +1,531 @@
+"""256-bit word arithmetic as 8 x u32 limb vectors (little-endian limbs).
+
+This is the foundation of the TPU interpreter: every EVM word is a
+``uint32[..., 8]`` array (limb 0 = least significant 32 bits). All ops are
+elementwise over leading batch dims, so the whole frontier's stacks are
+transformed in one XLA op sequence — this is the idiomatic replacement for
+the reference's per-object Python bigints in
+``mythril/laser/ethereum/instructions.py`` (⚠unv, see SURVEY.md §2).
+
+Intermediates use u64 (requires jax_enable_x64; enabled in package
+__init__). A Pallas kernel can later replace the hot paths (mul/div) —
+the API here is the stable surface.
+
+Conventions:
+- all binary ops broadcast over leading dims;
+- EVM semantics: DIV/MOD by zero -> 0; SDIV overflow (-2^255 / -1) -> -2^255;
+- shifts with amount >= 256 -> 0 (SAR -> sign fill).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+NLIMBS = 8
+LIMB_BITS = 32
+WORD_BITS = 256
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+_MASK32 = jnp.uint64(0xFFFFFFFF)
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (numpy, not traced)
+# ---------------------------------------------------------------------------
+
+
+def from_int(x: int) -> np.ndarray:
+    """Python int (mod 2^256) -> u32[8] limbs, little-endian."""
+    x &= (1 << 256) - 1
+    return np.array([(x >> (32 * i)) & 0xFFFFFFFF for i in range(NLIMBS)], dtype=np.uint32)
+
+
+def from_ints(xs) -> np.ndarray:
+    return np.stack([from_int(int(x)) for x in xs], axis=0)
+
+
+def to_int(limbs) -> int:
+    """u32[8] limbs -> Python int."""
+    limbs = np.asarray(limbs, dtype=np.uint64)
+    out = 0
+    for i in range(NLIMBS):
+        out |= int(limbs[..., i]) << (32 * i)
+    return out
+
+
+def to_ints(arr) -> list:
+    arr = np.asarray(arr)
+    flat = arr.reshape(-1, NLIMBS)
+    return [to_int(row) for row in flat]
+
+
+def from_bytes(b: bytes) -> np.ndarray:
+    """Big-endian byte string (<=32 bytes) -> u32[8]."""
+    return from_int(int.from_bytes(b, "big"))
+
+
+def to_bytes(limbs) -> bytes:
+    return to_int(limbs).to_bytes(32, "big")
+
+
+# ---------------------------------------------------------------------------
+# Constructors (traced)
+# ---------------------------------------------------------------------------
+
+
+def zeros(shape=()) -> jax.Array:
+    return jnp.zeros(tuple(shape) + (NLIMBS,), dtype=_U32)
+
+
+def ones_word(shape=()) -> jax.Array:
+    """The value 1."""
+    z = np.zeros(tuple(shape) + (NLIMBS,), dtype=np.uint32)
+    z[..., 0] = 1
+    return jnp.asarray(z)
+
+
+def full_like_int(ref: jax.Array, value: int) -> jax.Array:
+    """Broadcast a Python constant to ref's batch shape."""
+    w = jnp.asarray(from_int(value))
+    return jnp.broadcast_to(w, ref.shape[:-1] + (NLIMBS,))
+
+
+def from_u64_scalar(x) -> jax.Array:
+    """Traced u64 scalar (batched) -> u256 limbs."""
+    x = x.astype(_U64)
+    lo = (x & _MASK32).astype(_U32)
+    hi = (x >> 32).astype(_U32)
+    rest = jnp.zeros(x.shape + (NLIMBS - 2,), dtype=_U32)
+    return jnp.concatenate([lo[..., None], hi[..., None], rest], axis=-1)
+
+
+def to_u64_saturating(a: jax.Array):
+    """Low 64 bits, saturating to 2^64-1 if any higher limb set (for gas/len)."""
+    lo = a[..., 0].astype(_U64) | (a[..., 1].astype(_U64) << 32)
+    overflow = jnp.any(a[..., 2:] != 0, axis=-1)
+    return jnp.where(overflow, jnp.uint64(0xFFFFFFFFFFFFFFFF), lo)
+
+
+def to_u32_saturating(a: jax.Array):
+    """Low 32 bits, saturating if any higher limb set (for pc/offsets)."""
+    overflow = jnp.any(a[..., 1:] != 0, axis=-1)
+    return jnp.where(overflow, jnp.uint32(0xFFFFFFFF), a[..., 0])
+
+
+# ---------------------------------------------------------------------------
+# Bitwise
+# ---------------------------------------------------------------------------
+
+
+def bit_and(a, b):
+    return a & b
+
+
+def bit_or(a, b):
+    return a | b
+
+
+def bit_xor(a, b):
+    return a ^ b
+
+
+def bit_not(a):
+    return ~a
+
+
+# ---------------------------------------------------------------------------
+# Predicates
+# ---------------------------------------------------------------------------
+
+
+def is_zero(a) -> jax.Array:
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b) -> jax.Array:
+    return jnp.all(a == b, axis=-1)
+
+
+def msb(a) -> jax.Array:
+    """Sign bit (bit 255) as bool."""
+    return (a[..., NLIMBS - 1] >> 31) != 0
+
+
+is_neg = msb
+
+
+def lt(a, b) -> jax.Array:
+    """Unsigned a < b."""
+    # Compare from the most significant limb down, vectorized:
+    # a < b iff at the highest differing limb, a's limb < b's limb.
+    neq = a != b  # [..., 8]
+    a_lt = a < b  # [..., 8]
+    # index of most significant differing limb; if none differ -> equal -> False
+    # Trick: scan from high to low using a "decided" mask.
+    decided = jnp.zeros(a.shape[:-1], dtype=bool)
+    result = jnp.zeros(a.shape[:-1], dtype=bool)
+    for i in range(NLIMBS - 1, -1, -1):
+        take = (~decided) & neq[..., i]
+        result = jnp.where(take, a_lt[..., i], result)
+        decided = decided | neq[..., i]
+    return result
+
+
+def gt(a, b) -> jax.Array:
+    return lt(b, a)
+
+
+def gte(a, b) -> jax.Array:
+    return ~lt(a, b)
+
+
+def lte(a, b) -> jax.Array:
+    return ~lt(b, a)
+
+
+def slt(a, b) -> jax.Array:
+    """Signed a < b (two's complement)."""
+    sa, sb = msb(a), msb(b)
+    # different signs: a<b iff a is negative
+    return jnp.where(sa != sb, sa, lt(a, b))
+
+
+def sgt(a, b) -> jax.Array:
+    return slt(b, a)
+
+
+def bool_to_word(p) -> jax.Array:
+    """bool[...] -> u256 0/1."""
+    out = jnp.zeros(p.shape + (NLIMBS,), dtype=_U32)
+    return out.at[..., 0].set(p.astype(_U32))
+
+
+# ---------------------------------------------------------------------------
+# Add / Sub / Neg
+# ---------------------------------------------------------------------------
+
+
+def add(a, b):
+    return add_carry(a, b)[0]
+
+
+def add_carry(a, b):
+    """(a + b mod 2^256, carry_out bool)."""
+    a, b = jnp.broadcast_arrays(a, b)
+    out = []
+    c = jnp.zeros(a.shape[:-1], dtype=_U64)
+    for i in range(NLIMBS):
+        s = a[..., i].astype(_U64) + b[..., i].astype(_U64) + c
+        out.append((s & _MASK32).astype(_U32))
+        c = s >> 32
+    return jnp.stack(out, axis=-1), c != 0
+
+
+def sub(a, b):
+    return sub_borrow(a, b)[0]
+
+
+def sub_borrow(a, b):
+    """(a - b mod 2^256, borrow_out bool). borrow_out == (a < b)."""
+    a, b = jnp.broadcast_arrays(a, b)
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=_U64)
+    for i in range(NLIMBS):
+        d = a[..., i].astype(_U64) - b[..., i].astype(_U64) - borrow
+        out.append((d & _MASK32).astype(_U32))
+        borrow = (d >> 63) & 1  # underflow wraps in u64; top bit set iff borrow
+    return jnp.stack(out, axis=-1), borrow != 0
+
+
+def neg(a):
+    """Two's complement negation."""
+    return add(~a, ones_word(a.shape[:-1]))
+
+
+def abs_signed(a):
+    """(|a| as unsigned, was_negative)."""
+    n = msb(a)
+    return jnp.where(n[..., None], neg(a), a), n
+
+
+# ---------------------------------------------------------------------------
+# Mul
+# ---------------------------------------------------------------------------
+
+
+def mul(a, b):
+    """Low 256 bits of a*b (schoolbook, u64 accumulation)."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a64 = a.astype(_U64)
+    b64 = b.astype(_U64)
+    res = [jnp.zeros(a.shape[:-1], dtype=_U64) for _ in range(NLIMBS)]
+    for i in range(NLIMBS):
+        carry = jnp.zeros(a.shape[:-1], dtype=_U64)
+        for j in range(NLIMBS - i):
+            t = res[i + j] + a64[..., i] * b64[..., j] + carry
+            res[i + j] = t & _MASK32
+            carry = t >> 32
+    return jnp.stack([r.astype(_U32) for r in res], axis=-1)
+
+
+def mul_wide(a, b):
+    """Full 512-bit product as u32[..., 16] limbs."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a64 = a.astype(_U64)
+    b64 = b.astype(_U64)
+    n_out = 2 * NLIMBS
+    res = [jnp.zeros(a.shape[:-1], dtype=_U64) for _ in range(n_out)]
+    for i in range(NLIMBS):
+        carry = jnp.zeros(a.shape[:-1], dtype=_U64)
+        for j in range(NLIMBS):
+            t = res[i + j] + a64[..., i] * b64[..., j] + carry
+            res[i + j] = t & _MASK32
+            carry = t >> 32
+        res[i + NLIMBS] = res[i + NLIMBS] + carry
+    # res[i+8] accumulated raw carries; normalize the top half
+    carry = jnp.zeros(a.shape[:-1], dtype=_U64)
+    for k in range(NLIMBS, n_out):
+        t = res[k] + carry
+        res[k] = t & _MASK32
+        carry = t >> 32
+    return jnp.stack([r.astype(_U32) for r in res], axis=-1)
+
+
+def mul_overflows(a, b):
+    """True iff a*b >= 2^256 (used by integer-overflow detection)."""
+    wide = mul_wide(a, b)
+    return jnp.any(wide[..., NLIMBS:] != 0, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Division (binary long division) and modulo
+# ---------------------------------------------------------------------------
+
+
+def divmod_u(a, b):
+    """Unsigned (a // b, a % b); division by zero -> (0, 0) per EVM.
+
+    Invariant r < b; r<<1 can still overflow past 2^256 when b > 2^255, so
+    the shifted-out bit is tracked: if set, the true r' >= 2^256 > b and the
+    subtraction must occur (the wrapped subtraction then yields the right
+    residue since 0 <= 2r+bit-b < 2^256).
+    """
+    a, b = jnp.broadcast_arrays(a, b)
+    batch = a.shape[:-1]
+
+    def body_safe(k, state):
+        q, r = state
+        i = 255 - k
+        limb = i // LIMB_BITS
+        shift = i % LIMB_BITS
+        bit = (jnp.take(a, limb, axis=-1) >> _U32(shift)) & _U32(1)
+        overflow = (r[..., NLIMBS - 1] >> 31) != 0  # bit shifted out of 2^256
+        hi_bits = r >> 31
+        r2 = r << 1
+        r2 = r2.at[..., 1:].set(r2[..., 1:] | hi_bits[..., :-1])
+        r2 = r2.at[..., 0].set(r2[..., 0] | bit)
+        ge = gte(r2, b) | overflow
+        r2 = jnp.where(ge[..., None], sub(r2, b), r2)
+        qbit = ge.astype(_U32) << _U32(shift)
+        qvec = jnp.where(jnp.arange(NLIMBS) == limb, qbit[..., None], _U32(0))
+        q = q | qvec
+        return (q, r2)
+
+    q0 = jnp.zeros(batch + (NLIMBS,), dtype=_U32)
+    r0 = jnp.zeros(batch + (NLIMBS,), dtype=_U32)
+    q, r = jax.lax.fori_loop(0, 256, body_safe, (q0, r0))
+    bz = is_zero(b)[..., None]
+    return jnp.where(bz, 0, q).astype(_U32), jnp.where(bz, 0, r).astype(_U32)
+
+
+def div(a, b):
+    return divmod_u(a, b)[0]
+
+
+def mod(a, b):
+    return divmod_u(a, b)[1]
+
+
+def sdiv(a, b):
+    aa, na = abs_signed(a)
+    ab, nb = abs_signed(b)
+    q = div(aa, ab)
+    flip = na != nb
+    q = jnp.where(flip[..., None], neg(q), q)
+    # EVM: -2^255 / -1 wraps to -2^255 — this falls out of two's complement
+    return jnp.where(is_zero(b)[..., None], 0, q).astype(_U32)
+
+
+def smod(a, b):
+    aa, na = abs_signed(a)
+    ab, _ = abs_signed(b)
+    r = mod(aa, ab)
+    r = jnp.where(na[..., None], neg(r), r)
+    return jnp.where(is_zero(b)[..., None], 0, r).astype(_U32)
+
+
+def _mod_wide(wide, m):
+    """(u32[...,16] value) mod (u256 m); m==0 -> 0. 512-step long division."""
+    batch = wide.shape[:-1]
+    n_in = wide.shape[-1]
+    nbits = n_in * LIMB_BITS
+
+    def body(k, r):
+        i = nbits - 1 - k
+        limb = i // LIMB_BITS
+        shift = i % LIMB_BITS
+        bit = (jnp.take(wide, limb, axis=-1) >> _U32(shift)) & _U32(1)
+        overflow = (r[..., NLIMBS - 1] >> 31) != 0
+        hi_bits = r >> 31
+        r2 = r << 1
+        r2 = r2.at[..., 1:].set(r2[..., 1:] | hi_bits[..., :-1])
+        r2 = r2.at[..., 0].set(r2[..., 0] | bit)
+        ge = gte(r2, m) | overflow
+        r2 = jnp.where(ge[..., None], sub(r2, m), r2)
+        return r2
+
+    r0 = jnp.zeros(batch + (NLIMBS,), dtype=_U32)
+    r = jax.lax.fori_loop(0, nbits, body, r0)
+    return jnp.where(is_zero(m)[..., None], 0, r).astype(_U32)
+
+
+def addmod(a, b, m):
+    """(a + b) mod m over a 9-limb (288-bit) intermediate."""
+    s, carry = add_carry(a, b)
+    wide = jnp.concatenate([s, carry.astype(_U32)[..., None]], axis=-1)
+    return _mod_wide(wide, m)
+
+
+def mulmod(a, b, m):
+    return _mod_wide(mul_wide(a, b), m)
+
+
+# ---------------------------------------------------------------------------
+# Exp / SignExtend / Byte / Shifts
+# ---------------------------------------------------------------------------
+
+
+def exp(base, e):
+    """base ** e mod 2^256, square-and-multiply (MSB-first)."""
+    base, e = jnp.broadcast_arrays(base, e)
+    batch = base.shape[:-1]
+
+    def body(k, acc):
+        i = 255 - k
+        limb = i // LIMB_BITS
+        shift = i % LIMB_BITS
+        bit = ((jnp.take(e, limb, axis=-1) >> _U32(shift)) & _U32(1)) != 0
+        acc = mul(acc, acc)
+        acc = jnp.where(bit[..., None], mul(acc, base), acc)
+        return acc
+
+    one = jnp.broadcast_to(jnp.asarray(from_int(1)), batch + (NLIMBS,))
+    return jax.lax.fori_loop(0, 256, body, one)
+
+
+def signextend(k, x):
+    """EVM SIGNEXTEND: extend sign from byte k (0 = least significant byte).
+
+    If k >= 31, x is unchanged.
+    """
+    k32 = to_u32_saturating(k).astype(jnp.int64)  # saturates; >=31 -> no-op
+    t = 8 * k32 + 7  # sign bit position
+    bit_index = jnp.clip(t, 0, 255)
+    limb = (bit_index // LIMB_BITS).astype(jnp.int32)
+    shift = (bit_index % LIMB_BITS).astype(_U32)
+    sign = ((jnp.take_along_axis(x, limb[..., None], axis=-1)[..., 0] >> shift) & 1) != 0
+    # mask of bits <= t (keep), bits above t get the sign
+    limb_ids = jnp.arange(NLIMBS)
+    # per-limb: bits kept in this limb
+    bits_into_limb = bit_index[..., None] - limb_ids * LIMB_BITS  # how many bits-1 kept
+    keep_all = bits_into_limb >= (LIMB_BITS - 1)
+    keep_none = bits_into_limb < 0
+    partial_shift = jnp.clip(bits_into_limb + 1, 0, LIMB_BITS - 1).astype(_U32)
+    partial_mask = ((_U32(1) << partial_shift) - _U32(1)).astype(_U32)
+    keep_mask = jnp.where(keep_all, _U32(0xFFFFFFFF), jnp.where(keep_none, _U32(0), partial_mask))
+    ext = jnp.where(sign[..., None], ~keep_mask, _U32(0))
+    res = (x & keep_mask) | ext
+    noop = k32 >= 31
+    return jnp.where(noop[..., None], x, res).astype(_U32)
+
+
+def byte_op(i, x):
+    """EVM BYTE: i-th byte of x counting from the most significant; >=32 -> 0."""
+    i32 = to_u32_saturating(i).astype(jnp.int64)
+    oob = i32 >= 32
+    j = jnp.clip(31 - i32, 0, 31)  # byte index from LSB
+    limb = (j // 4).astype(jnp.int32)
+    shift = ((j % 4) * 8).astype(_U32)
+    b = (jnp.take_along_axis(x, limb[..., None], axis=-1)[..., 0] >> shift) & _U32(0xFF)
+    b = jnp.where(oob, _U32(0), b)
+    out = jnp.zeros(x.shape, dtype=_U32)
+    return out.at[..., 0].set(b)
+
+
+def _shift_limbs_left(a, limb_shift):
+    """Shift left by limb_shift whole limbs (traced int32)."""
+    idx = jnp.arange(NLIMBS) - limb_shift[..., None]
+    valid = idx >= 0
+    gathered = jnp.take_along_axis(a, jnp.clip(idx, 0, NLIMBS - 1).astype(jnp.int32), axis=-1)
+    return jnp.where(valid, gathered, _U32(0))
+
+
+def _shift_limbs_right(a, limb_shift):
+    idx = jnp.arange(NLIMBS) + limb_shift[..., None]
+    valid = idx < NLIMBS
+    gathered = jnp.take_along_axis(a, jnp.clip(idx, 0, NLIMBS - 1).astype(jnp.int32), axis=-1)
+    return jnp.where(valid, gathered, _U32(0))
+
+
+def shl(s, a):
+    """a << s (EVM operand order: shift amount first)."""
+    s64 = to_u64_saturating(s)
+    big = s64 >= 256
+    sh = jnp.clip(s64, 0, 255).astype(jnp.int64)
+    ls = (sh // LIMB_BITS).astype(jnp.int32)
+    bs = (sh % LIMB_BITS).astype(_U32)
+    moved = _shift_limbs_left(a, ls)
+    lo = moved << bs[..., None]
+    # bits carried from the next-lower limb; when bs != 0, (32-bs) is in [1,31]
+    carry = jnp.where(bs[..., None] == 0, _U32(0),
+                      (moved >> ((_U32(32) - bs) % _U32(32))[..., None]))
+    out = lo
+    out = out.at[..., 1:].set(out[..., 1:] | carry[..., :-1])
+    return jnp.where(big[..., None], _U32(0), out)
+
+
+def shr(s, a):
+    """Logical a >> s."""
+    s64 = to_u64_saturating(s)
+    big = s64 >= 256
+    sh = jnp.clip(s64, 0, 255).astype(jnp.int64)
+    ls = (sh // LIMB_BITS).astype(jnp.int32)
+    bs = (sh % LIMB_BITS).astype(_U32)
+    moved = _shift_limbs_right(a, ls)
+    hi = moved >> bs[..., None]
+    carry = jnp.where(bs[..., None] == 0, _U32(0),
+                      (moved << ((_U32(32) - bs) % _U32(32))[..., None]))
+    out = hi
+    out = out.at[..., :-1].set(out[..., :-1] | carry[..., 1:])
+    return jnp.where(big[..., None], _U32(0), out)
+
+
+def sar(s, a):
+    """Arithmetic a >> s."""
+    neg_in = msb(a)
+    logical = shr(s, a)
+    s64 = to_u64_saturating(s)
+    big = s64 >= 256
+    sh = jnp.clip(s64, 0, 255).astype(jnp.int64)
+    # fill mask: top `sh` bits set
+    # build via shl of all-ones by (256 - sh)
+    all_ones = jnp.broadcast_to(_U32(0xFFFFFFFF), a.shape)
+    fill_amount = 256 - sh
+    fa = from_u64_scalar(fill_amount.astype(_U64))
+    fill = shl(fa, all_ones)
+    filled = logical | fill
+    res = jnp.where(neg_in[..., None], filled, logical)
+    neg_big = jnp.broadcast_to(_U32(0xFFFFFFFF), a.shape)
+    res_big = jnp.where(neg_in[..., None], neg_big, _U32(0))
+    return jnp.where(big[..., None], res_big, res).astype(_U32)

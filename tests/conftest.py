@@ -1,0 +1,19 @@
+"""Test harness: force JAX onto CPU with 8 virtual devices.
+
+Mirrors the reference's "no chain needed" test philosophy (SURVEY.md §4):
+the reference tests LASER with hand-built fixtures and mocked RPC; we test
+the TPU framework on a virtual 8-device CPU mesh so CI needs no TPU, and
+multi-chip sharding is exercised via xla_force_host_platform_device_count.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mythril_tpu  # noqa: E402,F401  (enables x64)

@@ -1,0 +1,218 @@
+"""Property tests: u256 limb ops vs Python big-int ground truth.
+
+Analog of the reference's SMT-layer unit tests (tests/laser/smt/*, ⚠unv,
+SURVEY.md §4) — here the "SMT wrapper semantics" under test are the limb
+kernels every interpreter op is built from.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.ops import u256
+
+M = (1 << 256) - 1
+
+
+def _rand_words(rng, n):
+    """Mix of random bit-widths and edge cases."""
+    out = []
+    edge = [0, 1, 2, M, M - 1, 1 << 255, (1 << 255) - 1, 1 << 128, (1 << 128) - 1,
+            1 << 32, (1 << 32) - 1, 1 << 31, 255, 256, 31, 32]
+    out.extend(edge)
+    while len(out) < n:
+        bits = rng.randrange(1, 257)
+        out.append(rng.getrandbits(bits))
+    return out[:n]
+
+
+@pytest.fixture(scope="module")
+def words():
+    rng = random.Random(1234)
+    n = 64
+    a = _rand_words(rng, n)
+    b = list(a)
+    rng.shuffle(b)
+    return a, b
+
+
+def _check_binary(fn, pyfn, a_ints, b_ints):
+    a = np.stack([u256.from_int(x) for x in a_ints])
+    b = np.stack([u256.from_int(x) for x in b_ints])
+    got = np.asarray(fn(a, b))
+    for i, (x, y) in enumerate(zip(a_ints, b_ints)):
+        expect = pyfn(x, y) & M
+        assert u256.to_int(got[i]) == expect, f"{fn.__name__}({hex(x)}, {hex(y)})"
+
+
+def _sgn(x):
+    """Interpret u256 as two's-complement signed."""
+    return x - (1 << 256) if x >> 255 else x
+
+
+def test_add(words):
+    _check_binary(u256.add, lambda x, y: x + y, *words)
+
+
+def test_sub(words):
+    _check_binary(u256.sub, lambda x, y: x - y, *words)
+
+
+def test_mul(words):
+    _check_binary(u256.mul, lambda x, y: x * y, *words)
+
+
+def test_div(words):
+    _check_binary(u256.div, lambda x, y: x // y if y else 0, *words)
+
+
+def test_mod(words):
+    _check_binary(u256.mod, lambda x, y: x % y if y else 0, *words)
+
+
+def test_sdiv(words):
+    def py_sdiv(x, y):
+        sx, sy = _sgn(x), _sgn(y)
+        if sy == 0:
+            return 0
+        q = abs(sx) // abs(sy)
+        if (sx < 0) != (sy < 0):
+            q = -q
+        return q
+
+    _check_binary(u256.sdiv, py_sdiv, *words)
+
+
+def test_smod(words):
+    def py_smod(x, y):
+        sx, sy = _sgn(x), _sgn(y)
+        if sy == 0:
+            return 0
+        r = abs(sx) % abs(sy)
+        return -r if sx < 0 else r
+
+    _check_binary(u256.smod, py_smod, *words)
+
+
+def test_exp():
+    rng = random.Random(7)
+    bases = [0, 1, 2, 3, 255, 256, M, (1 << 128) + 5] + [rng.getrandbits(256) for _ in range(4)]
+    exps = [0, 1, 2, 3, 31, 255, 256, 1 << 16] + [rng.getrandbits(16) for _ in range(4)]
+    a = np.stack([u256.from_int(x) for x in bases])
+    b = np.stack([u256.from_int(x) for x in exps])
+    got = np.asarray(u256.exp(a, b))
+    for i, (x, y) in enumerate(zip(bases, exps)):
+        assert u256.to_int(got[i]) == pow(x, y, 1 << 256), f"exp({x},{y})"
+
+
+def test_addmod_mulmod(words):
+    a_ints, b_ints = words
+    rng = random.Random(99)
+    m_ints = [rng.getrandbits(rng.randrange(1, 257)) for _ in a_ints]
+    m_ints[0] = 0  # mod-zero case
+    a = np.stack([u256.from_int(x) for x in a_ints])
+    b = np.stack([u256.from_int(x) for x in b_ints])
+    m = np.stack([u256.from_int(x) for x in m_ints])
+    got_am = np.asarray(u256.addmod(a, b, m))
+    got_mm = np.asarray(u256.mulmod(a, b, m))
+    for i, (x, y, mm) in enumerate(zip(a_ints, b_ints, m_ints)):
+        assert u256.to_int(got_am[i]) == ((x + y) % mm if mm else 0)
+        assert u256.to_int(got_mm[i]) == ((x * y) % mm if mm else 0)
+
+
+def test_comparisons(words):
+    a_ints, b_ints = words
+    a = np.stack([u256.from_int(x) for x in a_ints])
+    b = np.stack([u256.from_int(x) for x in b_ints])
+    lt = np.asarray(u256.lt(a, b))
+    gt = np.asarray(u256.gt(a, b))
+    slt = np.asarray(u256.slt(a, b))
+    sgt = np.asarray(u256.sgt(a, b))
+    eq = np.asarray(u256.eq(a, b))
+    for i, (x, y) in enumerate(zip(a_ints, b_ints)):
+        assert bool(lt[i]) == (x < y)
+        assert bool(gt[i]) == (x > y)
+        assert bool(eq[i]) == (x == y)
+        assert bool(slt[i]) == (_sgn(x) < _sgn(y))
+        assert bool(sgt[i]) == (_sgn(x) > _sgn(y))
+
+
+def test_bitwise_and_not(words):
+    a_ints, b_ints = words
+    _check_binary(u256.bit_and, lambda x, y: x & y, a_ints, b_ints)
+    _check_binary(u256.bit_or, lambda x, y: x | y, a_ints, b_ints)
+    _check_binary(u256.bit_xor, lambda x, y: x ^ y, a_ints, b_ints)
+    a = np.stack([u256.from_int(x) for x in a_ints])
+    got = np.asarray(u256.bit_not(a))
+    for i, x in enumerate(a_ints):
+        assert u256.to_int(got[i]) == (~x) & M
+
+
+def test_shifts():
+    rng = random.Random(3)
+    vals = [rng.getrandbits(256) for _ in range(12)] + [1, M, 1 << 255]
+    shifts = [0, 1, 31, 32, 33, 63, 64, 127, 128, 255, 256, 300, rng.getrandbits(256),
+              1 << 64, 5]
+    vals = (vals * 2)[: len(shifts)]
+    v = np.stack([u256.from_int(x) for x in vals])
+    s = np.stack([u256.from_int(x) for x in shifts])
+    got_shl = np.asarray(u256.shl(s, v))
+    got_shr = np.asarray(u256.shr(s, v))
+    got_sar = np.asarray(u256.sar(s, v))
+    for i, (x, sh) in enumerate(zip(vals, shifts)):
+        exp_shl = (x << sh) & M if sh < 256 else 0
+        exp_shr = x >> sh if sh < 256 else 0
+        sx = _sgn(x)
+        exp_sar = (sx >> sh) & M if sh < 256 else (M if sx < 0 else 0)
+        assert u256.to_int(got_shl[i]) == exp_shl, f"shl {hex(x)} by {sh}"
+        assert u256.to_int(got_shr[i]) == exp_shr, f"shr {hex(x)} by {sh}"
+        assert u256.to_int(got_sar[i]) == exp_sar, f"sar {hex(x)} by {sh}"
+
+
+def test_byte_op():
+    x = 0x0102030405060708090A0B0C0D0E0F101112131415161718191A1B1C1D1E1F20
+    xs = np.stack([u256.from_int(x)] * 34)
+    idx = np.stack([u256.from_int(i) for i in range(34)])
+    got = np.asarray(u256.byte_op(idx, xs))
+    bs = x.to_bytes(32, "big")
+    for i in range(34):
+        expect = bs[i] if i < 32 else 0
+        assert u256.to_int(got[i]) == expect, f"byte {i}"
+
+
+def test_signextend():
+    cases = [
+        (0, 0xFF, M),            # byte 0, sign set -> all ones
+        (0, 0x7F, 0x7F),
+        (1, 0x8000, M - 0xFFFF + 0x8000),
+        (1, 0x7FFF, 0x7FFF),
+        (30, 1 << 247, ((M >> 248) << 248) | (1 << 247)),
+        (31, 0x1234, 0x1234),    # k >= 31 -> unchanged
+        (100, 0xDEAD, 0xDEAD),
+        (15, (1 << 127) | 5, (M ^ ((1 << 128) - 1)) | (1 << 127) | 5),
+    ]
+    k = np.stack([u256.from_int(c[0]) for c in cases])
+    x = np.stack([u256.from_int(c[1]) for c in cases])
+    got = np.asarray(u256.signextend(k, x))
+    for i, (kk, xx, expect) in enumerate(cases):
+        assert u256.to_int(got[i]) == expect, f"signextend({kk}, {hex(xx)})"
+
+
+def test_neg_iszero(words):
+    a_ints, _ = words
+    a = np.stack([u256.from_int(x) for x in a_ints])
+    got = np.asarray(u256.neg(a))
+    isz = np.asarray(u256.is_zero(a))
+    for i, x in enumerate(a_ints):
+        assert u256.to_int(got[i]) == (-x) & M
+        assert bool(isz[i]) == (x == 0)
+
+
+def test_mul_overflows(words):
+    a_ints, b_ints = words
+    a = np.stack([u256.from_int(x) for x in a_ints])
+    b = np.stack([u256.from_int(x) for x in b_ints])
+    got = np.asarray(u256.mul_overflows(a, b))
+    for i, (x, y) in enumerate(zip(a_ints, b_ints)):
+        assert bool(got[i]) == (x * y > M)
