@@ -1,0 +1,197 @@
+"""Keccak-256 — host reference + batched JAX keccak-f[1600] kernel.
+
+The reference delegates hashing to the C ``pysha3``/``safe-pysha3`` extension
+(⚠unv, SURVEY.md §2.2). Here:
+
+- :func:`keccak256_host` — pure-Python implementation for host-side needs
+  (selectors, CREATE2 addresses, test oracle). Anchored against published
+  keccak-256 test vectors in tests.
+- :func:`keccak_f1600` / :func:`keccak256_device` — the same permutation as
+  pure u64 bitwise ops over ``u64[..., 25]`` lane arrays, fully batched:
+  hashing N lanes of M bytes is one fused XLA op sequence. This is the
+  TPU replacement for per-call C hashing (SHA3 opcode over concrete
+  memory, storage-key hashing for mappings).
+
+Keccak (pre-NIST) padding: ``msg || 0x01 || 0* || 0x80``; rate 136 bytes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+RATE_BYTES = 136  # 1088-bit rate for keccak-256
+RATE_LANES = RATE_BYTES // 8
+
+_RC = np.array(
+    [
+        0x0000000000000001, 0x0000000000008082, 0x800000000000808A, 0x8000000080008000,
+        0x000000000000808B, 0x0000000080000001, 0x8000000080008081, 0x8000000000008009,
+        0x000000000000008A, 0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+        0x000000008000808B, 0x800000000000008B, 0x8000000000008089, 0x8000000000008003,
+        0x8000000000008002, 0x8000000000000080, 0x000000000000800A, 0x800000008000000A,
+        0x8000000080008081, 0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+    ],
+    dtype=np.uint64,
+)
+
+# rotation offsets r[x][y] for lane A[x, y]
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+_M64 = (1 << 64) - 1
+
+
+# ---------------------------------------------------------------------------
+# Host reference (pure Python ints)
+# ---------------------------------------------------------------------------
+
+
+def _rotl_int(x: int, n: int) -> int:
+    n %= 64
+    return ((x << n) | (x >> (64 - n))) & _M64
+
+
+def _f1600_host(lanes: list) -> list:
+    # lanes: flat list of 25 ints, A[x, y] = lanes[5*y + x]
+    a = [[lanes[5 * y + x] for y in range(5)] for x in range(5)]
+    for rnd in range(24):
+        c = [a[x][0] ^ a[x][1] ^ a[x][2] ^ a[x][3] ^ a[x][4] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl_int(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x][y] ^= d[x]
+        b = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                b[y][(2 * x + 3 * y) % 5] = _rotl_int(a[x][y], _ROT[x][y])
+        for x in range(5):
+            for y in range(5):
+                a[x][y] = b[x][y] ^ ((~b[(x + 1) % 5][y] & _M64) & b[(x + 2) % 5][y])
+        a[0][0] ^= int(_RC[rnd])
+    return [a[x][y] for y in range(5) for x in range(5)]
+
+
+def keccak256_host(data: bytes) -> bytes:
+    """Keccak-256 of concrete bytes (host path; test oracle)."""
+    padded = bytearray(data)
+    padded.append(0x01)
+    while len(padded) % RATE_BYTES:
+        padded.append(0x00)
+    padded[-1] |= 0x80
+    lanes = [0] * 25
+    for off in range(0, len(padded), RATE_BYTES):
+        block = padded[off : off + RATE_BYTES]
+        for i in range(RATE_LANES):
+            lanes[i] ^= int.from_bytes(block[8 * i : 8 * i + 8], "little")
+        lanes = _f1600_host(lanes)
+    out = b"".join(int(lanes[i]).to_bytes(8, "little") for i in range(4))
+    return out
+
+
+def keccak256_host_int(data: bytes) -> int:
+    return int.from_bytes(keccak256_host(data), "big")
+
+
+# ---------------------------------------------------------------------------
+# Batched JAX kernel
+# ---------------------------------------------------------------------------
+
+
+def _rotl(x, n: int):
+    n %= 64
+    if n == 0:
+        return x
+    return (x << jnp.uint64(n)) | (x >> jnp.uint64(64 - n))
+
+
+def keccak_f1600(state):
+    """keccak-f[1600] permutation over ``u64[..., 25]`` (A[x,y] = [..., 5y+x])."""
+    a = [[state[..., 5 * y + x] for y in range(5)] for x in range(5)]
+    rc = jnp.asarray(_RC)
+
+    def round_fn(rnd, a_flat):
+        a = [[a_flat[5 * y + x] for y in range(5)] for x in range(5)]
+        c = [a[x][0] ^ a[x][1] ^ a[x][2] ^ a[x][3] ^ a[x][4] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        a = [[a[x][y] ^ d[x] for y in range(5)] for x in range(5)]
+        b = [[None] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                b[y][(2 * x + 3 * y) % 5] = _rotl(a[x][y], _ROT[x][y])
+        a = [
+            [b[x][y] ^ ((~b[(x + 1) % 5][y]) & b[(x + 2) % 5][y]) for y in range(5)]
+            for x in range(5)
+        ]
+        a[0][0] = a[0][0] ^ rc[rnd]
+        return [a[x][y] for y in range(5) for x in range(5)]
+
+    a_flat = [a[x][y] for y in range(5) for x in range(5)]
+    a_flat = jax.lax.fori_loop(0, 24, round_fn, a_flat)
+    return jnp.stack(a_flat, axis=-1)
+
+
+def keccak256_device(data, length):
+    """Batched keccak-256.
+
+    data:   ``u8[..., max_len]`` zero-padded message bytes
+    length: ``i32[...]`` actual message lengths (<= max_len)
+    returns ``u32[..., 8]`` hash as little-endian u256 limbs
+            (limb 0 = least-significant 32 bits of the big-endian hash value).
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    max_len = data.shape[-1]
+    batch = data.shape[:-1]
+    # message + at least one pad byte must fit
+    n_blocks = (max_len + 1 + RATE_BYTES - 1) // RATE_BYTES
+    padded_len = n_blocks * RATE_BYTES
+
+    pos = jnp.arange(padded_len, dtype=jnp.int32)
+    src = jnp.pad(data, [(0, 0)] * len(batch) + [(0, padded_len - max_len)])
+    msg = jnp.where(pos < length[..., None], src, 0)
+    msg = jnp.where(pos == length[..., None], jnp.uint8(0x01), msg)
+    # 0x80 closes the final block (the one containing the 0x01)
+    final_block = length // RATE_BYTES  # block index holding byte `length`
+    last_byte_pos = (final_block + 1) * RATE_BYTES - 1
+    msg = jnp.where(pos == last_byte_pos[..., None], msg | jnp.uint8(0x80), msg)
+
+    # bytes -> u64 lanes, little-endian: lane j of block b = bytes [b*136+8j .. +8)
+    msg64 = msg.astype(jnp.uint64)
+    lanes_all = msg64.reshape(batch + (n_blocks, RATE_LANES, 8))
+    shifts = (jnp.arange(8, dtype=jnp.uint64) * 8)
+    blocks = jnp.sum(lanes_all << shifts, axis=-1)  # [..., n_blocks, 17]
+
+    state0 = jnp.zeros(batch + (25,), dtype=jnp.uint64)
+
+    def absorb(i, state):
+        blk = jnp.take(blocks, i, axis=-2)  # [..., 17]
+        xored = state.at[..., :RATE_LANES].set(state[..., :RATE_LANES] ^ blk)
+        nxt = keccak_f1600(xored)
+        active = (i <= final_block)[..., None]
+        return jnp.where(active, nxt, state)
+
+    state = jax.lax.fori_loop(0, n_blocks, absorb, state0)
+
+    # squeeze 32 bytes = lanes 0..3 little-endian; convert to LE u32 limbs of
+    # the big-endian hash integer: byte k of the hash (k=0 most significant
+    # byte... k=0 is FIRST hash byte = most significant of the value)
+    lanes4 = state[..., :4]  # u64
+    byte_idx = jnp.arange(32)
+    hash_bytes = (
+        jnp.take(lanes4, byte_idx // 8, axis=-1) >> (8 * (byte_idx % 8)).astype(jnp.uint64)
+    ) & jnp.uint64(0xFF)  # [..., 32], hash byte k
+    # limb i (value bits [32i, 32i+32)) = bytes k in [28-4i, 31-4i], k smaller = more significant
+    limb_ids = jnp.arange(8)
+    k_base = 28 - 4 * limb_ids  # most-significant byte index per limb
+    gather = k_base[:, None] + jnp.arange(4)[None, :]  # [8, 4]
+    b = jnp.take(hash_bytes, gather.reshape(-1), axis=-1).reshape(batch + (8, 4))
+    weights = jnp.uint64(1) << (jnp.uint64(8) * (3 - jnp.arange(4)).astype(jnp.uint64))
+    limbs = jnp.sum(b * weights, axis=-1).astype(jnp.uint32)
+    return limbs
