@@ -1,0 +1,51 @@
+"""Static-shape configuration for the device interpreter.
+
+The reference has no analog — Python objects grow unboundedly
+(``MachineState.stack`` is a list, memory a lazy dict ⚠unv, SURVEY.md §2
+"State model"). On TPU every dimension is static; these caps define the
+frontier array shapes. Lanes that exceed a cap raise a per-lane error flag
+(masked trap) rather than crashing the batch — SURVEY.md §5.2.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class LimitsConfig:
+    """Shape caps for one frontier. All sizes static at trace time."""
+
+    max_stack: int = 64  # EVM allows 1024; real contracts stay far below
+    mem_bytes: int = 4096  # byte-addressable memory cap per lane
+    calldata_bytes: int = 256  # symbolic tx calldata cap
+    returndata_bytes: int = 256
+    storage_slots: int = 32  # associative storage-cache entries per lane
+    max_code: int = 24576  # EIP-170 runtime-code limit
+    max_hash_bytes: int = 200  # SHA3 input cap (mapping keys are 64 bytes)
+    log_slots: int = 8  # recorded LOG entries per lane
+    tape_len: int = 512  # symbolic SSA tape nodes per lane
+    max_constraints: int = 64  # path-condition slots per lane
+    call_depth: int = 4  # saved call contexts per lane
+
+    def __post_init__(self):
+        assert self.max_stack >= 17  # SWAP16 arity
+        assert self.mem_bytes % 32 == 0
+
+
+DEFAULT_LIMITS = LimitsConfig()
+
+# Small limits for fast unit tests
+TEST_LIMITS = LimitsConfig(
+    max_stack=32,
+    mem_bytes=1024,
+    calldata_bytes=128,
+    returndata_bytes=128,
+    storage_slots=16,
+    max_code=512,
+    max_hash_bytes=136,
+    log_slots=4,
+    tape_len=128,
+    max_constraints=32,
+    call_depth=2,
+)

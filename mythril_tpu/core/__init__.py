@@ -1,0 +1,11 @@
+"""Core runtime: SoA frontier + vectorized EVM superstep.
+
+TPU-native counterpart of the reference's LASER engine
+(``mythril/laser/ethereum/{svm,instructions,state/*}.py`` ⚠unv,
+SURVEY.md §2/§3.2): instead of per-state Python objects stepped one at a
+time, the whole frontier of (contract, path) lanes is one struct-of-arrays
+pytree advanced by a single jitted superstep.
+"""
+
+from .frontier import Frontier, Env, Corpus, make_frontier, make_env  # noqa: F401
+from .interpreter import superstep, run  # noqa: F401

@@ -1,0 +1,197 @@
+"""The SoA frontier: all machine state for P lanes as fixed-shape arrays.
+
+Replaces the reference's per-path object graph — ``GlobalState`` /
+``MachineState`` / ``Account.storage`` / calldata objects
+(``mythril/laser/ethereum/state/*.py`` ⚠unv, SURVEY.md §2 "State model") —
+with one pytree of arrays whose leading dim is the lane index. A lane is
+one (contract, path) pair; masks (``active``/``halted``/``error``) play the
+role of the reference's work-list membership.
+
+Storage is a bounded per-lane associative cache (key/value/used arrays)
+rather than a Z3 ``Array``: SLOAD is a vectorized compare-select across
+slots, SSTORE a masked scatter into the matching-or-free slot. Cache
+overflow raises ``error`` (masked trap), host spill arrives with the
+multi-tx layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import LimitsConfig, DEFAULT_LIMITS
+from ..ops import u256
+
+
+@struct.dataclass
+class Frontier:
+    # --- control ---
+    active: jnp.ndarray  # bool[P] lane holds a live path
+    halted: jnp.ndarray  # bool[P] executed STOP/RETURN/REVERT/SELFDESTRUCT
+    error: jnp.ndarray  # bool[P] abnormal halt (invalid op, stack, bad jump, oob)
+    reverted: jnp.ndarray  # bool[P] halted via REVERT
+    pc: jnp.ndarray  # i32[P]
+    contract_id: jnp.ndarray  # i32[P] index into Corpus arrays
+    # --- stack ---
+    stack: jnp.ndarray  # u32[P, S, 8]
+    sp: jnp.ndarray  # i32[P] number of occupied slots
+    # --- memory ---
+    memory: jnp.ndarray  # u8[P, M]
+    mem_words: jnp.ndarray  # i32[P] highest touched 32-byte word count (MSIZE/gas)
+    # --- gas used (min/max accounting, reference: MachineState min_gas_used/max_gas_used) ---
+    gas_min: jnp.ndarray  # i64[P]
+    gas_max: jnp.ndarray  # i64[P]
+    gas_limit: jnp.ndarray  # i64[P]
+    # --- storage associative cache ---
+    st_keys: jnp.ndarray  # u32[P, K, 8]
+    st_vals: jnp.ndarray  # u32[P, K, 8]
+    st_used: jnp.ndarray  # bool[P, K]
+    st_written: jnp.ndarray  # bool[P, K] written (vs merely loaded) this tx
+    # --- calldata / returndata ---
+    calldata: jnp.ndarray  # u8[P, CD]
+    calldata_len: jnp.ndarray  # i32[P]
+    returndata: jnp.ndarray  # u8[P, RD] (from most recent sub-call)
+    returndata_len: jnp.ndarray  # i32[P]
+    retval: jnp.ndarray  # u8[P, RD] RETURN/REVERT payload of this frame
+    retval_len: jnp.ndarray  # i32[P]
+    # --- events ---
+    n_logs: jnp.ndarray  # i32[P]
+    selfdestructed: jnp.ndarray  # bool[P] executed SELFDESTRUCT
+
+    @property
+    def n_lanes(self) -> int:
+        return self.pc.shape[0]
+
+    @property
+    def max_stack(self) -> int:
+        return self.stack.shape[1]
+
+    @property
+    def running(self) -> jnp.ndarray:
+        """Lanes that still execute: active and not halted/errored."""
+        return self.active & ~self.halted & ~self.error
+
+
+@struct.dataclass
+class Env:
+    """Per-lane execution environment (reference: ``Environment`` +
+    block info from ``GlobalState`` ⚠unv). u256 limb arrays [P, 8]."""
+
+    address: jnp.ndarray
+    caller: jnp.ndarray
+    origin: jnp.ndarray
+    callvalue: jnp.ndarray
+    gasprice: jnp.ndarray
+    balance: jnp.ndarray  # balance of `address` (world-state integration later)
+    coinbase: jnp.ndarray
+    timestamp: jnp.ndarray
+    number: jnp.ndarray
+    prevrandao: jnp.ndarray
+    blk_gaslimit: jnp.ndarray
+    chainid: jnp.ndarray
+    basefee: jnp.ndarray
+
+
+@struct.dataclass
+class Corpus:
+    """Shared contract images (one per contract, lanes index via contract_id)."""
+
+    code: jnp.ndarray  # u8[C, MAX_CODE]
+    code_len: jnp.ndarray  # i32[C]
+    is_jumpdest: jnp.ndarray  # bool[C, MAX_CODE]
+
+    @staticmethod
+    def from_images(images) -> "Corpus":
+        return Corpus(
+            code=jnp.asarray(np.stack([im.code for im in images])),
+            code_len=jnp.asarray(np.array([im.code_len for im in images], dtype=np.int32)),
+            is_jumpdest=jnp.asarray(np.stack([im.is_jumpdest for im in images])),
+        )
+
+
+def make_frontier(
+    n_lanes: int,
+    limits: LimitsConfig = DEFAULT_LIMITS,
+    contract_id=None,
+    calldata: Optional[np.ndarray] = None,
+    calldata_len=None,
+    gas_limit: int = 10_000_000,
+    active=None,
+) -> Frontier:
+    P = n_lanes
+    L = limits
+    z8 = lambda *s: jnp.zeros(s + (8,), dtype=jnp.uint32)
+    if contract_id is None:
+        contract_id = jnp.zeros(P, dtype=jnp.int32)
+    if calldata is None:
+        calldata = jnp.zeros((P, L.calldata_bytes), dtype=jnp.uint8)
+    else:
+        calldata = jnp.asarray(calldata, dtype=jnp.uint8)
+        assert calldata.shape == (P, L.calldata_bytes)
+    if calldata_len is None:
+        calldata_len = jnp.zeros(P, dtype=jnp.int32)
+    if active is None:
+        active = jnp.ones(P, dtype=bool)
+    return Frontier(
+        active=active,
+        halted=jnp.zeros(P, dtype=bool),
+        error=jnp.zeros(P, dtype=bool),
+        reverted=jnp.zeros(P, dtype=bool),
+        pc=jnp.zeros(P, dtype=jnp.int32),
+        contract_id=jnp.asarray(contract_id, dtype=jnp.int32),
+        stack=z8(P, L.max_stack),
+        sp=jnp.zeros(P, dtype=jnp.int32),
+        memory=jnp.zeros((P, L.mem_bytes), dtype=jnp.uint8),
+        mem_words=jnp.zeros(P, dtype=jnp.int32),
+        gas_min=jnp.zeros(P, dtype=jnp.int64),
+        gas_max=jnp.zeros(P, dtype=jnp.int64),
+        gas_limit=jnp.full(P, gas_limit, dtype=jnp.int64),
+        st_keys=z8(P, L.storage_slots),
+        st_vals=z8(P, L.storage_slots),
+        st_used=jnp.zeros((P, L.storage_slots), dtype=bool),
+        st_written=jnp.zeros((P, L.storage_slots), dtype=bool),
+        calldata=calldata,
+        calldata_len=jnp.asarray(calldata_len, dtype=jnp.int32),
+        returndata=jnp.zeros((P, L.returndata_bytes), dtype=jnp.uint8),
+        returndata_len=jnp.zeros(P, dtype=jnp.int32),
+        retval=jnp.zeros((P, L.returndata_bytes), dtype=jnp.uint8),
+        retval_len=jnp.zeros(P, dtype=jnp.int32),
+        n_logs=jnp.zeros(P, dtype=jnp.int32),
+        selfdestructed=jnp.zeros(P, dtype=bool),
+    )
+
+
+def make_env(
+    n_lanes: int,
+    address: int = 0xAFFE,
+    caller: int = 0xDEADBEEF,
+    origin: Optional[int] = None,
+    callvalue: int = 0,
+    balance: int = 10**18,
+    timestamp: int = 1_700_000_000,
+    number: int = 17_000_000,
+    chainid: int = 1,
+) -> Env:
+    P = n_lanes
+
+    def w(v: int):
+        return jnp.broadcast_to(jnp.asarray(u256.from_int(v)), (P, 8))
+
+    return Env(
+        address=w(address),
+        caller=w(caller),
+        origin=w(origin if origin is not None else caller),
+        callvalue=w(callvalue),
+        gasprice=w(10**9),
+        balance=w(balance),
+        coinbase=w(0xC01BA5E),
+        timestamp=w(timestamp),
+        number=w(number),
+        prevrandao=w(0x123456789ABCDEF),
+        blk_gaslimit=w(30_000_000),
+        chainid=w(chainid),
+        basefee=w(10**9),
+    )

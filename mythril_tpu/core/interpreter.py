@@ -1,0 +1,651 @@
+"""Vectorized concrete EVM superstep.
+
+Counterpart of the reference's per-opcode ``Instruction.evaluate`` +
+``LaserEVM.execute_state`` (``mythril/laser/ethereum/{instructions,svm}.py``
+⚠unv, SURVEY.md §3.2), re-designed frontier-first:
+
+- Handlers operate on the WHOLE frontier with a lane mask (no vmap of a
+  scalar interpreter): every update is `jnp.where(mask, new, old)`.
+- Dispatch is per opcode *class* behind `lax.cond(jnp.any(mask))` — a
+  superstep pays only for classes present in the frontier. This matters
+  because DIV/EXP/MODARITH are 256-step `fori_loop`s that must not run
+  when no lane needs them.
+- Stack-arity validation and min/max gas accounting happen once per step
+  from dense tables (reference: the ``StateTransition`` decorator).
+
+CALL/CREATE are stubbed at this layer (success push); real sub-transaction
+semantics live in the symbolic VM layer above.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import LimitsConfig, DEFAULT_LIMITS
+from ..disassembler import opcodes as oc
+from ..ops import u256
+from ..ops.keccak import keccak256_device
+from .frontier import Frontier, Env, Corpus
+
+I64 = jnp.int64
+I32 = jnp.int32
+U32 = jnp.uint32
+U8 = jnp.uint8
+
+# ---------------------------------------------------------------------------
+# Opcode classes (dispatch granularity)
+# ---------------------------------------------------------------------------
+
+CLS_STACK, CLS_ALU, CLS_MUL, CLS_DIVMOD, CLS_MODARITH, CLS_EXP, CLS_SHA3, CLS_ENV, \
+    CLS_COPY, CLS_MEM, CLS_STORAGE, CLS_JUMP, CLS_HALT, CLS_LOG, CLS_CALL, CLS_CREATE = range(16)
+
+N_CLASSES = 16
+
+
+def _build_class_table() -> np.ndarray:
+    t = np.full(256, CLS_HALT, dtype=np.int32)  # invalid opcodes -> filtered by IS_VALID
+    def s(codes, cls):
+        for c in codes:
+            t[c] = cls
+
+    s([0x50, 0x58, 0x59, 0x5A, 0x5B] + list(range(0x5F, 0xA0)), CLS_STACK)  # POP PC MSIZE GAS JUMPDEST PUSH* DUP* SWAP*
+    s([0x01, 0x03, 0x10, 0x11, 0x12, 0x13, 0x14, 0x15, 0x16, 0x17, 0x18, 0x19,
+       0x0B, 0x1A, 0x1B, 0x1C, 0x1D], CLS_ALU)
+    s([0x02], CLS_MUL)
+    s([0x04, 0x05, 0x06, 0x07], CLS_DIVMOD)
+    s([0x08, 0x09], CLS_MODARITH)
+    s([0x0A], CLS_EXP)
+    s([0x20], CLS_SHA3)
+    s([0x30, 0x31, 0x32, 0x33, 0x34, 0x35, 0x36, 0x38, 0x3A, 0x3B, 0x3D, 0x3F,
+       0x40, 0x41, 0x42, 0x43, 0x44, 0x45, 0x46, 0x47, 0x48], CLS_ENV)
+    s([0x37, 0x39, 0x3C, 0x3E], CLS_COPY)
+    s([0x51, 0x52, 0x53], CLS_MEM)
+    s([0x54, 0x55], CLS_STORAGE)
+    s([0x56, 0x57], CLS_JUMP)
+    s([0x00, 0xF3, 0xFD, 0xFE, 0xFF], CLS_HALT)
+    s(list(range(0xA0, 0xA5)), CLS_LOG)
+    s([0xF1, 0xF2, 0xF4, 0xFA], CLS_CALL)
+    s([0xF0, 0xF5], CLS_CREATE)
+    return t
+
+
+CLASS_TABLE = _build_class_table()
+
+# jnp views of the metadata tables (built once at import)
+_J_STACK_IN = jnp.asarray(oc.STACK_IN)
+_J_STACK_OUT = jnp.asarray(oc.STACK_OUT)
+_J_GAS_MIN = jnp.asarray(oc.GAS_MIN)
+_J_GAS_MAX = jnp.asarray(oc.GAS_MAX)
+_J_PUSH_WIDTH = jnp.asarray(oc.PUSH_WIDTH)
+_J_IS_VALID = jnp.asarray(oc.IS_VALID)
+_J_CLASS = jnp.asarray(CLASS_TABLE)
+
+
+# ---------------------------------------------------------------------------
+# Stack helpers (frontier-level)
+# ---------------------------------------------------------------------------
+
+
+def _peek(f: Frontier, i) -> jnp.ndarray:
+    """Stack slot i from the top (i static int or i32[P]); u32[P, 8]."""
+    idx = jnp.clip(f.sp - 1 - i, 0, f.max_stack - 1)
+    return jnp.take_along_axis(f.stack, idx[:, None, None].astype(I32), axis=1)[:, 0]
+
+
+def _set_slot(stack, pos, val, mask):
+    """stack[P,S,8] with stack[lane, pos[lane]] = val[lane] where mask."""
+    S = stack.shape[1]
+    sel = (jnp.arange(S)[None, :] == pos[:, None]) & mask[:, None]
+    return jnp.where(sel[:, :, None], val[:, None, :], stack)
+
+
+def _word_to_be_bytes(val) -> jnp.ndarray:
+    """u256 limbs [P,8] -> big-endian bytes u8[P,32] (byte 0 most significant)."""
+    k = jnp.arange(32)
+    limb = (31 - k) // 4
+    shift = (8 * ((31 - k) % 4)).astype(U32)
+    return ((jnp.take(val, limb, axis=-1) >> shift) & U32(0xFF)).astype(U8)
+
+
+def _be_bytes_to_word(b) -> jnp.ndarray:
+    """big-endian bytes u8/u32[P,32] -> u256 limbs u32[P,8]."""
+    b = b.astype(U32)
+    limb_ids = jnp.arange(8)
+    k_base = 28 - 4 * limb_ids  # most-significant byte index per limb
+    gather = (k_base[:, None] + jnp.arange(4)[None, :]).reshape(-1)
+    bb = jnp.take(b, gather, axis=-1).reshape(b.shape[:-1] + (8, 4))
+    w = U32(1) << (U32(8) * (3 - jnp.arange(4)).astype(U32))
+    return jnp.sum(bb * w, axis=-1).astype(U32)
+
+
+def _gather_bytes(buf, start, n_static: int, limit):
+    """buf[P, L] bytes; read n_static bytes from per-lane offset start,
+    zero-filled past `limit` (per-lane logical length). Returns u8[P, n]."""
+    idx = start[:, None].astype(I64) + jnp.arange(n_static, dtype=I64)[None, :]
+    L = buf.shape[1]
+    safe = jnp.clip(idx, 0, L - 1).astype(I32)
+    vals = jnp.take_along_axis(buf, safe, axis=1)
+    ok = (idx >= 0) & (idx < limit[:, None].astype(I64)) & (idx < L)
+    return jnp.where(ok, vals, 0)
+
+
+def _scatter_bytes(memory, start, vals, n_static: int, mask):
+    """memory[P,M]; write vals[P,n] at per-lane offset start where mask."""
+    P, M = memory.shape
+    idx = start[:, None].astype(I64) + jnp.arange(n_static, dtype=I64)[None, :]
+    idx = jnp.where(mask[:, None] & (idx >= 0) & (idx < M), idx, M)  # M = dropped
+    lanes = jnp.broadcast_to(jnp.arange(P)[:, None], idx.shape)
+    return memory.at[lanes, idx.astype(I32)].set(vals, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# Memory expansion (EVM yellow-paper cost: 3w + w^2/512)
+# ---------------------------------------------------------------------------
+
+
+def _mem_cost(words):
+    w = words.astype(I64)
+    return 3 * w + (w * w) // 512
+
+
+def _expand_memory(f: Frontier, mask, end_bytes) -> Tuple[Frontier, jnp.ndarray]:
+    """Charge expansion to end_bytes (i64[P]); flags error past the cap.
+    Returns (frontier, oob_mask)."""
+    M = f.memory.shape[1]
+    end = jnp.maximum(end_bytes.astype(I64), 0)
+    oob = mask & (end > M)
+    words = (jnp.clip(end, 0, M) + 31) // 32
+    new_words = jnp.where(mask, jnp.maximum(f.mem_words.astype(I64), words), f.mem_words.astype(I64))
+    delta = _mem_cost(new_words) - _mem_cost(f.mem_words.astype(I64))
+    return (
+        f.replace(
+            mem_words=new_words.astype(I32),
+            gas_min=f.gas_min + jnp.where(mask, delta, 0),
+            gas_max=f.gas_max + jnp.where(mask, delta, 0),
+            error=f.error | oob,
+        ),
+        oob,
+    )
+
+
+def _charge(f: Frontier, mask, amount) -> Frontier:
+    amt = jnp.where(mask, amount.astype(I64), 0)
+    return f.replace(gas_min=f.gas_min + amt, gas_max=f.gas_max + amt)
+
+
+# ---------------------------------------------------------------------------
+# Class handlers — each: (f, env, corpus, op, mask, old_pc) -> f
+# ---------------------------------------------------------------------------
+
+
+def _h_stack(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    is_push = (op >= 0x5F) & (op <= 0x7F)
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+
+    # PUSH immediate: big-endian `width` bytes following pc
+    width = jnp.where(is_push, op.astype(I32) - 0x5F, 0)
+    code_row = corpus.code[f.contract_id]  # u8[P, MC]
+    code_len = corpus.code_len[f.contract_id]
+    raw = _gather_bytes(code_row, old_pc + 1, 32, code_len)  # u8[P,32]
+    j = jnp.arange(32)
+    sig = width[:, None] - 1 - j[None, :]  # byte significance (bytes); <0 = beyond width
+    in_range = sig >= 0
+    limb_idx = jnp.clip(sig, 0, 255) // 4  # [P,32]
+    shift = (8 * (jnp.clip(sig, 0, 255) % 4)).astype(U32)
+    contrib = jnp.where(in_range, raw.astype(U32) << shift, 0)
+    onehot = limb_idx[:, :, None] == jnp.arange(8)[None, None, :]
+    push_val = jnp.sum(jnp.where(onehot, contrib[:, :, None], 0), axis=1).astype(U32)
+
+    dup_n = jnp.where(is_dup, op.astype(I32) - 0x7F, 1)
+    dup_val = _peek(f, dup_n - 1)
+    pc_val = u256.from_u64_scalar(old_pc.astype(jnp.uint64))
+    msize_val = u256.from_u64_scalar((f.mem_words.astype(jnp.uint64)) * 32)
+    gas_val = u256.from_u64_scalar(jnp.maximum(f.gas_limit - f.gas_max, 0).astype(jnp.uint64))
+
+    val = jnp.where(
+        is_push[:, None], push_val,
+        jnp.where(is_dup[:, None], dup_val,
+                  jnp.where((op == 0x58)[:, None], pc_val,
+                            jnp.where((op == 0x59)[:, None], msize_val, gas_val))))
+    does_push = is_push | is_dup | (op == 0x58) | (op == 0x59) | (op == 0x5A)
+    stack = _set_slot(f.stack, f.sp, val, m & does_push)
+
+    # SWAP n: exchange top with slot n below top
+    swap_n = jnp.where(is_swap, op.astype(I32) - 0x8F, 1)
+    top = _peek(f, 0)
+    deep = _peek(f, swap_n)
+    stack = _set_slot(stack, f.sp - 1, deep, m & is_swap)
+    stack = _set_slot(stack, f.sp - 1 - swap_n, top, m & is_swap)
+
+    d_sp = _J_STACK_OUT[op] - _J_STACK_IN[op]
+    sp = jnp.where(m, f.sp + d_sp, f.sp)
+    return f.replace(stack=stack, sp=sp)
+
+
+def _h_alu(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    a = _peek(f, 0)
+    b = _peek(f, 1)
+    is_unary = (op == 0x15) | (op == 0x19)  # ISZERO NOT
+
+    r = u256.add(a, b)
+    r = jnp.where((op == 0x03)[:, None], u256.sub(a, b), r)
+    r = jnp.where((op == 0x10)[:, None], u256.bool_to_word(u256.lt(a, b)), r)
+    r = jnp.where((op == 0x11)[:, None], u256.bool_to_word(u256.gt(a, b)), r)
+    r = jnp.where((op == 0x12)[:, None], u256.bool_to_word(u256.slt(a, b)), r)
+    r = jnp.where((op == 0x13)[:, None], u256.bool_to_word(u256.sgt(a, b)), r)
+    r = jnp.where((op == 0x14)[:, None], u256.bool_to_word(u256.eq(a, b)), r)
+    r = jnp.where((op == 0x15)[:, None], u256.bool_to_word(u256.is_zero(a)), r)
+    r = jnp.where((op == 0x16)[:, None], a & b, r)
+    r = jnp.where((op == 0x17)[:, None], a | b, r)
+    r = jnp.where((op == 0x18)[:, None], a ^ b, r)
+    r = jnp.where((op == 0x19)[:, None], ~a, r)
+    r = jnp.where((op == 0x0B)[:, None], u256.signextend(a, b), r)
+    r = jnp.where((op == 0x1A)[:, None], u256.byte_op(a, b), r)
+    r = jnp.where((op == 0x1B)[:, None], u256.shl(a, b), r)
+    r = jnp.where((op == 0x1C)[:, None], u256.shr(a, b), r)
+    r = jnp.where((op == 0x1D)[:, None], u256.sar(a, b), r)
+
+    dest = jnp.where(is_unary, f.sp - 1, f.sp - 2)
+    stack = _set_slot(f.stack, dest, r, m)
+    sp = jnp.where(m & ~is_unary, f.sp - 1, f.sp)
+    return f.replace(stack=stack, sp=sp)
+
+
+def _h_mul(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    r = u256.mul(_peek(f, 0), _peek(f, 1))
+    stack = _set_slot(f.stack, f.sp - 2, r, m)
+    return f.replace(stack=stack, sp=jnp.where(m, f.sp - 1, f.sp))
+
+
+def _h_divmod(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    a, b = _peek(f, 0), _peek(f, 1)
+    signed = (op == 0x05) | (op == 0x07)  # SDIV SMOD
+    aa, na = u256.abs_signed(a)
+    ab, nb = u256.abs_signed(b)
+    da = jnp.where(signed[:, None], aa, a)
+    db = jnp.where(signed[:, None], ab, b)
+    q, rem = u256.divmod_u(da, db)  # one shared 256-step division
+    q_signed = jnp.where((na != nb)[:, None], u256.neg(q), q)
+    rem_signed = jnp.where(na[:, None], u256.neg(rem), rem)
+    bz = u256.is_zero(b)[:, None]
+    is_div = (op == 0x04) | (op == 0x05)
+    r = jnp.where(
+        is_div[:, None],
+        jnp.where(signed[:, None], q_signed, q),
+        jnp.where(signed[:, None], rem_signed, rem),
+    )
+    r = jnp.where(bz, 0, r).astype(U32)
+    stack = _set_slot(f.stack, f.sp - 2, r, m)
+    return f.replace(stack=stack, sp=jnp.where(m, f.sp - 1, f.sp))
+
+
+def _h_modarith(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    a, b, n = _peek(f, 0), _peek(f, 1), _peek(f, 2)
+    is_add = op == 0x08
+    wide_mul = u256.mul_wide(a, b)  # u32[P,16]
+    s, carry = u256.add_carry(a, b)
+    wide_add = jnp.concatenate(
+        [s, carry.astype(U32)[:, None], jnp.zeros_like(s)[:, :7]], axis=-1
+    )
+    wide = jnp.where(is_add[:, None], wide_add, wide_mul)
+    r = u256._mod_wide(wide, n)
+    stack = _set_slot(f.stack, f.sp - 3, r, m)
+    return f.replace(stack=stack, sp=jnp.where(m, f.sp - 2, f.sp))
+
+
+def _h_exp(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    base, e = _peek(f, 0), _peek(f, 1)
+    r = u256.exp(base, e)
+    stack = _set_slot(f.stack, f.sp - 2, r, m)
+    # dynamic gas: 50 per significant exponent byte
+    e_bytes = _word_to_be_bytes(e)
+    nz = e_bytes != 0
+    first_nz = jnp.argmax(nz, axis=1)  # 0 if none
+    any_nz = jnp.any(nz, axis=1)
+    n_bytes = jnp.where(any_nz, 32 - first_nz, 0).astype(I64)
+    f = _charge(f, m, 50 * n_bytes)
+    return f.replace(stack=stack, sp=jnp.where(m, f.sp - 1, f.sp))
+
+
+MAX_HASH_BYTES = 200  # SHA3 input cap (mapping keys need 64; see LimitsConfig)
+
+
+def _h_sha3(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    off = u256.to_u64_saturating(_peek(f, 0)).astype(I64)
+    ln = u256.to_u64_saturating(_peek(f, 1)).astype(I64)
+    H = f.memory.shape[1]  # gather window limited by memory size
+    max_hash = min(MAX_HASH_BYTES, H)
+    too_long = m & (ln > max_hash)
+    f, oob = _expand_memory(f, m & (ln > 0), off + ln)
+    ok = m & ~too_long & ~oob
+    data = _gather_bytes(f.memory, off, max_hash, jnp.full_like(off, H))
+    # zero bytes past ln
+    data = jnp.where(jnp.arange(max_hash)[None, :] < ln[:, None], data, 0)
+    digest = keccak256_device(data, jnp.clip(ln, 0, max_hash).astype(I32))
+    stack = _set_slot(f.stack, f.sp - 2, digest, ok)
+    words = (ln + 31) // 32
+    f = _charge(f, ok, 6 * words)
+    return f.replace(
+        stack=stack,
+        sp=jnp.where(m, f.sp - 1, f.sp),
+        error=f.error | too_long,
+    )
+
+
+def _h_env(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    a = _peek(f, 0)  # operand for the 1-in ops
+    code_len = corpus.code_len[f.contract_id]
+
+    cd_load = _be_bytes_to_word(
+        _gather_bytes(f.calldata, u256.to_u64_saturating(a).astype(I64), 32, f.calldata_len)
+    )
+    self_addr = env.address
+    bal_query_self = u256.eq(a, self_addr)
+    balance_val = jnp.where(bal_query_self[:, None], env.balance, 0).astype(U32)
+
+    r = env.address
+    r = jnp.where((op == 0x31)[:, None], balance_val, r)
+    r = jnp.where((op == 0x32)[:, None], env.origin, r)
+    r = jnp.where((op == 0x33)[:, None], env.caller, r)
+    r = jnp.where((op == 0x34)[:, None], env.callvalue, r)
+    r = jnp.where((op == 0x35)[:, None], cd_load, r)
+    r = jnp.where((op == 0x36)[:, None], u256.from_u64_scalar(f.calldata_len.astype(jnp.uint64)), r)
+    r = jnp.where((op == 0x38)[:, None], u256.from_u64_scalar(code_len.astype(jnp.uint64)), r)
+    r = jnp.where((op == 0x3A)[:, None], env.gasprice, r)
+    # EXTCODESIZE/EXTCODEHASH: world-state integration later; self-query answered
+    ext_self = u256.eq(a, self_addr)
+    extsize = jnp.where(ext_self[:, None], u256.from_u64_scalar(code_len.astype(jnp.uint64)), 0).astype(U32)
+    r = jnp.where((op == 0x3B)[:, None], extsize, r)
+    r = jnp.where((op == 0x3D)[:, None], u256.from_u64_scalar(f.returndata_len.astype(jnp.uint64)), r)
+    r = jnp.where((op == 0x3F)[:, None], jnp.zeros_like(r), r)  # EXTCODEHASH stub
+    r = jnp.where((op == 0x40)[:, None], jnp.zeros_like(r), r)  # BLOCKHASH stub
+    r = jnp.where((op == 0x41)[:, None], env.coinbase, r)
+    r = jnp.where((op == 0x42)[:, None], env.timestamp, r)
+    r = jnp.where((op == 0x43)[:, None], env.number, r)
+    r = jnp.where((op == 0x44)[:, None], env.prevrandao, r)
+    r = jnp.where((op == 0x45)[:, None], env.blk_gaslimit, r)
+    r = jnp.where((op == 0x46)[:, None], env.chainid, r)
+    r = jnp.where((op == 0x47)[:, None], env.balance, r)
+    r = jnp.where((op == 0x48)[:, None], env.basefee, r)
+
+    sin = _J_STACK_IN[op]
+    dest = jnp.where(sin == 1, f.sp - 1, f.sp)
+    stack = _set_slot(f.stack, dest, r, m)
+    sp = jnp.where(m & (sin == 0), f.sp + 1, f.sp)
+    return f.replace(stack=stack, sp=sp)
+
+
+def _h_copy(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    is_ext = op == 0x3C  # EXTCODECOPY: (addr, dst, src, len)
+    dst = jnp.where(is_ext[:, None], _peek(f, 1), _peek(f, 0))
+    src = jnp.where(is_ext[:, None], _peek(f, 2), _peek(f, 1))
+    ln = jnp.where(is_ext[:, None], _peek(f, 3), _peek(f, 2))
+    dst64 = u256.to_u64_saturating(dst).astype(I64)
+    src64 = u256.to_u64_saturating(src).astype(I64)
+    ln64 = u256.to_u64_saturating(ln).astype(I64)
+
+    f, oob = _expand_memory(f, m & (ln64 > 0), dst64 + ln64)
+    ok = m & ~oob
+
+    P, M = f.memory.shape
+    jpos = jnp.arange(M, dtype=I64)[None, :]
+    in_window = (jpos >= dst64[:, None]) & (jpos < (dst64 + ln64)[:, None])
+    sidx = jpos - dst64[:, None] + src64[:, None]
+
+    # source byte per target position
+    cd = _take_per_lane(f.calldata, sidx, f.calldata_len.astype(I64))
+    code_row = corpus.code[f.contract_id]
+    code = _take_per_lane(code_row, sidx, corpus.code_len[f.contract_id].astype(I64))
+    rd = _take_per_lane(f.returndata, sidx, f.returndata_len.astype(I64))
+    srcb = jnp.where((op == 0x37)[:, None], cd,
+                     jnp.where((op == 0x39)[:, None], code,
+                               jnp.where((op == 0x3E)[:, None], rd, 0)))  # EXTCODECOPY -> zeros
+    memory = jnp.where(in_window & ok[:, None], srcb, f.memory)
+    words = (ln64 + 31) // 32
+    f = _charge(f, ok, 3 * words)
+    d_sp = _J_STACK_IN[op]
+    return f.replace(memory=memory.astype(U8), sp=jnp.where(m, f.sp - d_sp, f.sp))
+
+
+def _take_per_lane(buf, idx, limit):
+    """buf[P,L]; gather per-lane idx[P,N] with zero fill past limit[P]."""
+    L = buf.shape[1]
+    safe = jnp.clip(idx, 0, L - 1).astype(I32)
+    vals = jnp.take_along_axis(buf, safe, axis=1)
+    ok = (idx >= 0) & (idx < limit[:, None]) & (idx < L)
+    return jnp.where(ok, vals, 0)
+
+
+def _h_mem(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    off = u256.to_u64_saturating(_peek(f, 0)).astype(I64)
+    val = _peek(f, 1)
+    is_load = op == 0x51
+    is_store8 = op == 0x53
+    end = jnp.where(is_store8, off + 1, off + 32)
+    f, oob = _expand_memory(f, m, end)
+    ok = m & ~oob
+
+    # MLOAD
+    loaded = _be_bytes_to_word(
+        _gather_bytes(f.memory, off, 32, jnp.full_like(off, f.memory.shape[1]))
+    )
+    stack = _set_slot(f.stack, f.sp - 1, loaded, ok & is_load)
+
+    # MSTORE / MSTORE8
+    bytes32 = _word_to_be_bytes(val)
+    mem = _scatter_bytes(f.memory, off, bytes32, 32, ok & (op == 0x52))
+    low_byte = (val[:, 0] & U32(0xFF)).astype(U8)[:, None]
+    mem = _scatter_bytes(mem, off, low_byte, 1, ok & is_store8)
+
+    sp = jnp.where(m & ~is_load, f.sp - 2, f.sp)
+    return f.replace(stack=stack, memory=mem, sp=sp)
+
+
+def _storage_lookup(f: Frontier, key):
+    """(hit bool[P], value u32[P,8], hit_slot i32[P])"""
+    match = f.st_used & jnp.all(f.st_keys == key[:, None, :], axis=-1)  # [P,K]
+    hit = jnp.any(match, axis=1)
+    slot = jnp.argmax(match, axis=1).astype(I32)
+    val = jnp.sum(jnp.where(match[:, :, None], f.st_vals, 0), axis=1).astype(U32)
+    return hit, val, slot
+
+
+def _h_storage(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    key = _peek(f, 0)
+    val = _peek(f, 1)
+    is_store = op == 0x55
+    hit, cur, slot = _storage_lookup(f, key)
+
+    # SLOAD: miss -> 0 (clean storage; unconstrained/world storage in sym layer)
+    loaded = jnp.where(hit[:, None], cur, 0).astype(U32)
+    stack = _set_slot(f.stack, f.sp - 1, loaded, m & ~is_store)
+
+    # SSTORE: hit slot or first free slot; cache overflow -> lane error
+    free = ~f.st_used
+    has_free = jnp.any(free, axis=1)
+    free_slot = jnp.argmax(free, axis=1).astype(I32)
+    target = jnp.where(hit, slot, free_slot)
+    overflow = m & is_store & ~hit & ~has_free
+    wmask = m & is_store & ~overflow
+    K = f.st_used.shape[1]
+    onehot = (jnp.arange(K)[None, :] == target[:, None]) & wmask[:, None]
+    st_keys = jnp.where(onehot[:, :, None], key[:, None, :], f.st_keys)
+    st_vals = jnp.where(onehot[:, :, None], val[:, None, :], f.st_vals)
+    st_used = f.st_used | onehot
+    st_written = f.st_written | onehot
+
+    sp = jnp.where(m & is_store, f.sp - 2, f.sp)
+    return f.replace(
+        stack=stack, sp=sp, st_keys=st_keys, st_vals=st_vals,
+        st_used=st_used, st_written=st_written, error=f.error | overflow,
+    )
+
+
+def _h_jump(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    dest_w = _peek(f, 0)
+    cond = _peek(f, 1)
+    is_jumpi = op == 0x57
+    dest = u256.to_u64_saturating(dest_w).astype(I64)
+    MC = corpus.code.shape[1]
+    dest_ok_idx = jnp.clip(dest, 0, MC - 1).astype(I32)
+    valid_dest = (dest < MC) & jnp.take_along_axis(
+        corpus.is_jumpdest[f.contract_id], dest_ok_idx[:, None], axis=1
+    )[:, 0]
+    taken = ~u256.is_zero(cond) | ~is_jumpi  # JUMP always taken
+    bad = m & taken & ~valid_dest
+    new_pc = jnp.where(taken, dest.astype(I32), old_pc + 1)
+    pc = jnp.where(m & ~bad, new_pc, f.pc)
+    d_sp = jnp.where(is_jumpi, 2, 1)
+    return f.replace(pc=pc, sp=jnp.where(m, f.sp - d_sp, f.sp), error=f.error | bad)
+
+
+def _h_halt(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    is_return = op == 0xF3
+    is_revert = op == 0xFD
+    is_invalid = op == 0xFE
+    is_sd = op == 0xFF
+    has_data = is_return | is_revert
+
+    off = u256.to_u64_saturating(_peek(f, 0)).astype(I64)
+    ln = u256.to_u64_saturating(_peek(f, 1)).astype(I64)
+    f, oob = _expand_memory(f, m & has_data & (ln > 0), off + ln)
+    RD = f.retval.shape[1]
+    cap_len = jnp.clip(ln, 0, RD).astype(I32)
+    data = _gather_bytes(f.memory, off, RD, jnp.full_like(off, f.memory.shape[1]))
+    data = jnp.where(jnp.arange(RD)[None, :] < cap_len[:, None], data, 0)
+    wmask = m & has_data & ~oob
+    retval = jnp.where(wmask[:, None], data, f.retval)
+    retval_len = jnp.where(wmask, cap_len, f.retval_len)
+
+    # INVALID consumes all remaining gas
+    gas_min = jnp.where(m & is_invalid, f.gas_limit, f.gas_min)
+    gas_max = jnp.where(m & is_invalid, f.gas_limit, f.gas_max)
+
+    return f.replace(
+        halted=f.halted | (m & ~is_invalid),
+        error=f.error | (m & is_invalid),
+        reverted=f.reverted | (m & is_revert),
+        selfdestructed=f.selfdestructed | (m & is_sd),
+        retval=retval,
+        retval_len=retval_len,
+        gas_min=gas_min,
+        gas_max=gas_max,
+        sp=jnp.where(m, f.sp - _J_STACK_IN[op], f.sp),
+    )
+
+
+def _h_log(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    off = u256.to_u64_saturating(_peek(f, 0)).astype(I64)
+    ln = u256.to_u64_saturating(_peek(f, 1)).astype(I64)
+    f, _ = _expand_memory(f, m & (ln > 0), off + ln)
+    f = _charge(f, m, 8 * ln)
+    return f.replace(
+        n_logs=jnp.where(m, f.n_logs + 1, f.n_logs),
+        sp=jnp.where(m, f.sp - _J_STACK_IN[op], f.sp),
+    )
+
+
+def _h_call(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    """CALL family stub: success=1, empty returndata. Real sub-transactions
+    are orchestrated by the symbolic VM layer (reference: call_ raising
+    TransactionStartSignal ⚠unv)."""
+    sin = _J_STACK_IN[op]
+    one = jnp.zeros_like(_peek(f, 0)).at[:, 0].set(1)
+    dest = f.sp - sin
+    stack = _set_slot(f.stack, dest, one, m)
+    return f.replace(
+        stack=stack,
+        sp=jnp.where(m, f.sp - sin + 1, f.sp),
+        returndata_len=jnp.where(m, 0, f.returndata_len),
+    )
+
+
+def _h_create(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    """CREATE/CREATE2 stub: pushes zero address (creation semantics live in
+    the tx layer)."""
+    sin = _J_STACK_IN[op]
+    zero = jnp.zeros_like(_peek(f, 0))
+    off = u256.to_u64_saturating(_peek(f, 1)).astype(I64)
+    ln = u256.to_u64_saturating(_peek(f, 2)).astype(I64)
+    f, _ = _expand_memory(f, m & (ln > 0), off + ln)
+    stack = _set_slot(f.stack, f.sp - sin, zero, m)
+    return f.replace(stack=stack, sp=jnp.where(m, f.sp - sin + 1, f.sp))
+
+
+_HANDLERS = [
+    _h_stack, _h_alu, _h_mul, _h_divmod, _h_modarith, _h_exp, _h_sha3, _h_env,
+    _h_copy, _h_mem, _h_storage, _h_jump, _h_halt, _h_log, _h_call, _h_create,
+]
+
+
+# ---------------------------------------------------------------------------
+# Superstep
+# ---------------------------------------------------------------------------
+
+
+def superstep(f: Frontier, env: Env, corpus: Corpus) -> Frontier:
+    """Advance every running lane by one instruction."""
+    running = f.running
+    MC = corpus.code.shape[1]
+    pc_idx = jnp.clip(f.pc, 0, MC - 1)
+    op_raw = jnp.take_along_axis(corpus.code[f.contract_id], pc_idx[:, None], axis=1)[:, 0]
+    in_code = f.pc < corpus.code_len[f.contract_id]
+    op = jnp.where(running & in_code, op_raw, 0).astype(I32)  # off-end = STOP
+
+    # arity + validity traps (reference: StateTransition decorator checks)
+    sin = _J_STACK_IN[op]
+    sout = _J_STACK_OUT[op]
+    bad = running & (
+        (f.sp < sin) | (f.sp - sin + sout > f.max_stack) | ~_J_IS_VALID[op]
+    )
+    f = f.replace(error=f.error | bad)
+    run = running & ~bad
+
+    # base gas from tables
+    f = f.replace(
+        gas_min=f.gas_min + jnp.where(run, _J_GAS_MIN[op], 0),
+        gas_max=f.gas_max + jnp.where(run, _J_GAS_MAX[op], 0),
+    )
+
+    old_pc = f.pc
+    cls = _J_CLASS[op]
+    for cid, handler in enumerate(_HANDLERS):
+        mask = run & (cls == cid)
+        f = lax.cond(
+            jnp.any(mask),
+            lambda fr, h=handler, mk=mask: h(fr, env, corpus, op, mk, old_pc),
+            lambda fr: fr,
+            f,
+        )
+
+    # default pc advance for lanes the handlers didn't redirect/halt
+    advanced = run & (cls != CLS_JUMP) & ~f.halted & ~f.error
+    next_pc = old_pc + 1 + _J_PUSH_WIDTH[op]
+    f = f.replace(pc=jnp.where(advanced, next_pc, f.pc))
+
+    # out-of-gas trap (min-gas accounting exceeding the limit)
+    oog = run & (f.gas_min > f.gas_limit)
+    return f.replace(error=f.error | oog)
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def run(f: Frontier, env: Env, corpus: Corpus, max_steps: int = 256) -> Frontier:
+    """Run until every lane halts/errors or max_steps supersteps elapse."""
+
+    def cond(state):
+        i, fr = state
+        return (i < max_steps) & jnp.any(fr.running)
+
+    def body(state):
+        i, fr = state
+        return i + 1, superstep(fr, env, corpus)
+
+    _, f = lax.while_loop(cond, body, (jnp.int32(0), f))
+    return f

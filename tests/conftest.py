@@ -17,3 +17,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import mythril_tpu  # noqa: E402,F401  (enables x64)
+
+import jax  # noqa: E402
+
+# Persistent compilation cache: the superstep graph is large and this box has
+# one core — cache compiled executables across test runs.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

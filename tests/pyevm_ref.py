@@ -1,0 +1,381 @@
+"""Reference oracle: a tiny int-based concrete EVM for differential testing.
+
+Deliberately boring Python (dict memory/storage, Python ints) implementing
+the SAME semantic surface as mythril_tpu.core.interpreter, including its
+stub choices (CALL pushes success=1, EXTCODESIZE answers self-queries only,
+BLOCKHASH/EXTCODEHASH -> 0). Plays the role the Ethereum consensus VMTests
+play for the reference (SURVEY.md §4): an independent implementation to
+diff the vectorized interpreter against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mythril_tpu.disassembler import opcodes as oc
+from mythril_tpu.ops.keccak import keccak256_host_int
+
+M256 = (1 << 256) - 1
+SIGN = 1 << 255
+
+
+def _s(x):  # unsigned -> signed
+    return x - (1 << 256) if x & SIGN else x
+
+
+def _u(x):  # signed -> unsigned
+    return x & M256
+
+
+@dataclass
+class RefEnv:
+    address: int = 0xAFFE
+    caller: int = 0xDEADBEEF
+    origin: int = 0xDEADBEEF
+    callvalue: int = 0
+    gasprice: int = 10**9
+    balance: int = 10**18
+    coinbase: int = 0xC01BA5E
+    timestamp: int = 1_700_000_000
+    number: int = 17_000_000
+    prevrandao: int = 0x123456789ABCDEF
+    blk_gaslimit: int = 30_000_000
+    chainid: int = 1
+    basefee: int = 10**9
+
+
+@dataclass
+class RefResult:
+    stack: List[int]
+    storage: Dict[int, int]
+    memory: bytearray
+    halted: bool
+    error: bool
+    reverted: bool
+    selfdestructed: bool
+    retval: bytes
+    gas_min: int
+    gas_max: int
+    pc: int
+    n_logs: int
+    steps: int
+
+
+def _mem_cost(words: int) -> int:
+    return 3 * words + (words * words) // 512
+
+
+class RefEVM:
+    def __init__(self, code: bytes, calldata: bytes = b"", env: Optional[RefEnv] = None,
+                 gas_limit: int = 10_000_000, storage: Optional[Dict[int, int]] = None):
+        self.code = code
+        self.calldata = calldata
+        self.env = env or RefEnv()
+        self.gas_limit = gas_limit
+        self.storage: Dict[int, int] = dict(storage or {})
+        self.memory = bytearray()
+        self.stack: List[int] = []
+        self.pc = 0
+        self.halted = self.error = self.reverted = self.selfdestructed = False
+        self.retval = b""
+        self.gas_min = 0
+        self.gas_max = 0
+        self.mem_words = 0
+        self.returndata = b""
+        self.n_logs = 0
+        self.jumpdests = self._find_jumpdests()
+
+    def _find_jumpdests(self):
+        dests = set()
+        pc = 0
+        while pc < len(self.code):
+            op = self.code[pc]
+            if op == 0x5B:
+                dests.add(pc)
+            pc += 1 + int(oc.PUSH_WIDTH[op])
+        return dests
+
+    # -- helpers --
+    def _expand(self, end: int):
+        if end <= 0:
+            return
+        words = (end + 31) // 32
+        if words > self.mem_words:
+            delta = _mem_cost(words) - _mem_cost(self.mem_words)
+            self.gas_min += delta
+            self.gas_max += delta
+            self.mem_words = words
+        if len(self.memory) < words * 32:
+            self.memory.extend(b"\x00" * (words * 32 - len(self.memory)))
+
+    def _mread(self, off: int, n: int) -> bytes:
+        if n == 0:
+            return b""
+        self._expand(off + n)
+        return bytes(self.memory[off : off + n])
+
+    def _mwrite(self, off: int, data: bytes):
+        if not data:
+            return
+        self._expand(off + len(data))
+        self.memory[off : off + len(data)] = data
+
+    def _fail(self):
+        self.error = True
+
+    # -- main loop --
+    def run(self, max_steps: int = 256) -> RefResult:
+        steps = 0
+        while steps < max_steps and not (self.halted or self.error):
+            self.step()
+            steps += 1
+        return RefResult(
+            stack=list(self.stack), storage=dict(self.storage), memory=self.memory,
+            halted=self.halted, error=self.error, reverted=self.reverted,
+            selfdestructed=self.selfdestructed, retval=self.retval,
+            gas_min=self.gas_min, gas_max=self.gas_max, pc=self.pc,
+            n_logs=self.n_logs, steps=steps,
+        )
+
+    def step(self):
+        op = self.code[self.pc] if self.pc < len(self.code) else 0x00
+        info = oc.OPCODES.get(op)
+        if info is None:
+            return self._fail()
+        if len(self.stack) < info.stack_in or \
+                len(self.stack) - info.stack_in + info.stack_out > 10**9:
+            return self._fail()
+        self.gas_min += info.gas_min
+        self.gas_max += info.gas_max
+        pc0 = self.pc
+        self.pc += 1 + info.push_width
+        st = self.stack
+        name = info.name
+
+        def push(v):
+            st.append(v & M256)
+
+        if name.startswith("PUSH"):
+            w = info.push_width
+            push(int.from_bytes(self.code[pc0 + 1 : pc0 + 1 + w].ljust(w, b"\x00"), "big") if w else 0)
+        elif name.startswith("DUP"):
+            n = int(name[3:]); push(st[-n])
+        elif name.startswith("SWAP"):
+            n = int(name[4:]); st[-1], st[-1 - n] = st[-1 - n], st[-1]
+        elif name == "POP":
+            st.pop()
+        elif name == "PC":
+            push(pc0)
+        elif name == "MSIZE":
+            push(self.mem_words * 32)
+        elif name == "GAS":
+            push(max(self.gas_limit - self.gas_max, 0))
+        elif name == "JUMPDEST":
+            pass
+        elif name in ("ADD", "SUB", "MUL", "DIV", "SDIV", "MOD", "SMOD", "AND", "OR",
+                      "XOR", "LT", "GT", "SLT", "SGT", "EQ", "BYTE", "SHL", "SHR",
+                      "SAR", "SIGNEXTEND"):
+            a, b = st.pop(), st.pop()
+            if name == "ADD":
+                r = a + b
+            elif name == "SUB":
+                r = a - b
+            elif name == "MUL":
+                r = a * b
+            elif name == "DIV":
+                r = a // b if b else 0
+            elif name == "SDIV":
+                sa, sb = _s(a), _s(b)
+                r = _u(abs(sa) // abs(sb) * (1 if (sa < 0) == (sb < 0) else -1)) if sb else 0
+            elif name == "MOD":
+                r = a % b if b else 0
+            elif name == "SMOD":
+                sa, sb = _s(a), _s(b)
+                r = _u((abs(sa) % abs(sb)) * (-1 if sa < 0 else 1)) if sb else 0
+            elif name == "AND":
+                r = a & b
+            elif name == "OR":
+                r = a | b
+            elif name == "XOR":
+                r = a ^ b
+            elif name == "LT":
+                r = int(a < b)
+            elif name == "GT":
+                r = int(a > b)
+            elif name == "SLT":
+                r = int(_s(a) < _s(b))
+            elif name == "SGT":
+                r = int(_s(a) > _s(b))
+            elif name == "EQ":
+                r = int(a == b)
+            elif name == "BYTE":
+                r = (b >> (8 * (31 - a))) & 0xFF if a < 32 else 0
+            elif name == "SHL":
+                r = b << a if a < 256 else 0
+            elif name == "SHR":
+                r = b >> a if a < 256 else 0
+            elif name == "SAR":
+                r = _u(_s(b) >> a) if a < 256 else (M256 if _s(b) < 0 else 0)
+            elif name == "SIGNEXTEND":
+                if a >= 31:
+                    r = b
+                else:
+                    t = 8 * a + 7
+                    bit = (b >> t) & 1
+                    mask = (1 << (t + 1)) - 1
+                    r = (b & mask) | (~mask & M256 if bit else 0)
+            push(r)
+        elif name in ("ISZERO", "NOT"):
+            a = st.pop()
+            push(int(a == 0) if name == "ISZERO" else ~a)
+        elif name in ("ADDMOD", "MULMOD"):
+            a, b, n = st.pop(), st.pop(), st.pop()
+            if n == 0:
+                push(0)
+            else:
+                push((a + b) % n if name == "ADDMOD" else (a * b) % n)
+        elif name == "EXP":
+            a, b = st.pop(), st.pop()
+            n_bytes = (b.bit_length() + 7) // 8
+            self.gas_min += 50 * n_bytes
+            self.gas_max += 50 * n_bytes
+            push(pow(a, b, 1 << 256))
+        elif name == "SHA3":
+            off, ln = st.pop(), st.pop()
+            data = self._mread(off, ln)
+            words = (ln + 31) // 32
+            self.gas_min += 6 * words
+            self.gas_max += 6 * words
+            push(keccak256_host_int(data))
+        elif name == "ADDRESS":
+            push(self.env.address)
+        elif name == "BALANCE":
+            a = st.pop()
+            push(self.env.balance if a == self.env.address else 0)
+        elif name == "ORIGIN":
+            push(self.env.origin)
+        elif name == "CALLER":
+            push(self.env.caller)
+        elif name == "CALLVALUE":
+            push(self.env.callvalue)
+        elif name == "CALLDATALOAD":
+            off = st.pop()
+            if off >= len(self.calldata):
+                push(0)
+            else:
+                push(int.from_bytes(self.calldata[off : off + 32].ljust(32, b"\x00"), "big"))
+        elif name == "CALLDATASIZE":
+            push(len(self.calldata))
+        elif name == "CODESIZE":
+            push(len(self.code))
+        elif name == "GASPRICE":
+            push(self.env.gasprice)
+        elif name == "EXTCODESIZE":
+            a = st.pop()
+            push(len(self.code) if a == self.env.address else 0)
+        elif name == "RETURNDATASIZE":
+            push(len(self.returndata))
+        elif name in ("EXTCODEHASH", "BLOCKHASH"):
+            st.pop()
+            push(0)
+        elif name == "COINBASE":
+            push(self.env.coinbase)
+        elif name == "TIMESTAMP":
+            push(self.env.timestamp)
+        elif name == "NUMBER":
+            push(self.env.number)
+        elif name == "PREVRANDAO":
+            push(self.env.prevrandao)
+        elif name == "GASLIMIT":
+            push(self.env.blk_gaslimit)
+        elif name == "CHAINID":
+            push(self.env.chainid)
+        elif name == "SELFBALANCE":
+            push(self.env.balance)
+        elif name == "BASEFEE":
+            push(self.env.basefee)
+        elif name in ("CALLDATACOPY", "CODECOPY", "RETURNDATACOPY", "EXTCODECOPY"):
+            if name == "EXTCODECOPY":
+                st.pop()  # addr (stub: zeros)
+                src_buf = b""
+            elif name == "CALLDATACOPY":
+                src_buf = self.calldata
+            elif name == "CODECOPY":
+                src_buf = self.code
+            else:
+                src_buf = self.returndata
+            dst, src, ln = st.pop(), st.pop(), st.pop()
+            data = bytes(src_buf[src + i] if src + i < len(src_buf) else 0 for i in range(ln))
+            self._mwrite(dst, data)
+            words = (ln + 31) // 32
+            self.gas_min += 3 * words
+            self.gas_max += 3 * words
+        elif name == "MLOAD":
+            off = st.pop()
+            push(int.from_bytes(self._mread(off, 32), "big"))
+        elif name == "MSTORE":
+            off, v = st.pop(), st.pop()
+            self._mwrite(off, v.to_bytes(32, "big"))
+        elif name == "MSTORE8":
+            off, v = st.pop(), st.pop()
+            self._mwrite(off, bytes([v & 0xFF]))
+        elif name == "SLOAD":
+            push(self.storage.get(st.pop(), 0))
+        elif name == "SSTORE":
+            k, v = st.pop(), st.pop()
+            self.storage[k] = v
+        elif name == "JUMP":
+            dest = st.pop()
+            if dest in self.jumpdests:
+                self.pc = dest
+            else:
+                self._fail()
+        elif name == "JUMPI":
+            dest, cond = st.pop(), st.pop()
+            if cond:
+                if dest in self.jumpdests:
+                    self.pc = dest
+                else:
+                    self._fail()
+        elif name == "STOP":
+            self.halted = True
+        elif name in ("RETURN", "REVERT"):
+            off, ln = st.pop(), st.pop()
+            self.retval = self._mread(off, ln)
+            self.halted = True
+            self.reverted = name == "REVERT"
+        elif name == "INVALID":
+            self.error = True
+            self.gas_min = self.gas_limit
+            self.gas_max = self.gas_limit
+        elif name == "SELFDESTRUCT":
+            st.pop()
+            self.halted = True
+            self.selfdestructed = True
+        elif name.startswith("LOG"):
+            n = int(name[3:])
+            off, ln = st.pop(), st.pop()
+            for _ in range(n):
+                st.pop()
+            if ln:
+                self._expand(off + ln)
+            self.gas_min += 8 * ln
+            self.gas_max += 8 * ln
+            self.n_logs += 1
+        elif name in ("CALL", "CALLCODE", "DELEGATECALL", "STATICCALL"):
+            for _ in range(info.stack_in):
+                st.pop()
+            self.returndata = b""
+            push(1)
+        elif name in ("CREATE", "CREATE2"):
+            args = [st.pop() for _ in range(info.stack_in)]
+            off, ln = args[1], args[2]
+            if ln:
+                self._expand(off + ln)
+            push(0)
+        else:  # pragma: no cover
+            raise NotImplementedError(name)

@@ -1,0 +1,349 @@
+"""Differential tests: vectorized interpreter vs the int-based oracle.
+
+Mirrors the reference's per-opcode unit tests + consensus-suite style
+(SURVEY.md §4): each program is one lane of a batched corpus; the whole
+battery executes in ONE jitted run, then every lane is diffed against an
+independent Python EVM.
+"""
+
+import numpy as np
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.config import TEST_LIMITS
+from mythril_tpu.core import make_frontier, make_env, Corpus, run
+from mythril_tpu.disassembler import ContractImage
+from mythril_tpu.disassembler.opcodes import opcode_by_name
+from mythril_tpu.ops import u256
+
+from pyevm_ref import RefEVM, RefEnv
+
+M256 = (1 << 256) - 1
+GAS_LIMIT = 10_000_000
+
+
+# --- tiny assembler -------------------------------------------------------
+
+def A(*tokens) -> bytes:
+    """Assemble: str opcode | int value (PUSH32) | ('pushN', value)."""
+    out = bytearray()
+    for t in tokens:
+        if isinstance(t, str) and t.lower().startswith("push") and t[4:].isdigit():
+            raise ValueError("use ('pushN', value) tuples")
+        if isinstance(t, int):
+            out.append(0x7F)  # PUSH32
+            out += (t & M256).to_bytes(32, "big")
+        elif isinstance(t, tuple):
+            name, val = t
+            n = int(name[4:])
+            out.append(0x5F + n)
+            if n:
+                out += (val & ((1 << (8 * n)) - 1)).to_bytes(n, "big")
+        else:
+            out.append(opcode_by_name(t).opcode)
+    return bytes(out)
+
+
+# --- batched differential runner -----------------------------------------
+
+# All batteries are padded to one lane count so every test reuses a single
+# compiled executable (shapes are the jit cache key).
+P_FIXED = 96
+
+
+def run_battery(programs, calldatas=None, callvalue=0, max_steps=192):
+    n_real = len(programs)
+    assert n_real <= P_FIXED, f"battery too large: {n_real}"
+    programs = list(programs) + [bytes([0x00])] * (P_FIXED - n_real)
+    calldatas = list(calldatas or [b""] * n_real)
+    calldatas += [b""] * (P_FIXED - len(calldatas))
+    P = len(programs)
+    L = TEST_LIMITS
+    images = [ContractImage.from_bytecode(p, L.max_code) for p in programs]
+    corpus = Corpus.from_images(images)
+    cd = np.zeros((P, L.calldata_bytes), np.uint8)
+    cdl = np.zeros(P, np.int32)
+    for i, d in enumerate(calldatas):
+        cd[i, : len(d)] = np.frombuffer(d, dtype=np.uint8)
+        cdl[i] = len(d)
+    f = make_frontier(P, L, contract_id=np.arange(P, dtype=np.int32),
+                      calldata=cd, calldata_len=cdl, gas_limit=GAS_LIMIT)
+    env = make_env(P, callvalue=callvalue)
+    out = run(f, env, corpus, max_steps=max_steps)
+
+    refs = []
+    for p, d in zip(programs[:n_real], calldatas[:n_real]):
+        r = RefEVM(p, calldata=d, env=RefEnv(callvalue=callvalue),
+                   gas_limit=GAS_LIMIT).run(max_steps=max_steps)
+        refs.append(r)
+    return out, refs
+
+
+def check_lane(out, refs, i, compare_gas=True, compare_memory=True):
+    ref = refs[i]
+    tag = f"lane {i}"
+    error = bool(np.asarray(out.error)[i])
+    assert error == ref.error, f"{tag}: error {error} != {ref.error}"
+    if ref.error:
+        return  # post-error state is unspecified
+    assert bool(np.asarray(out.halted)[i]) == ref.halted, f"{tag}: halted"
+    assert bool(np.asarray(out.reverted)[i]) == ref.reverted, f"{tag}: reverted"
+    assert bool(np.asarray(out.selfdestructed)[i]) == ref.selfdestructed, f"{tag}: sd"
+    sp = int(np.asarray(out.sp)[i])
+    assert sp == len(ref.stack), f"{tag}: sp {sp} != {len(ref.stack)}"
+    stack = np.asarray(out.stack)[i]
+    for j in range(sp):
+        got = u256.to_int(stack[j])
+        assert got == ref.stack[j], f"{tag}: stack[{j}] {hex(got)} != {hex(ref.stack[j])}"
+    # storage
+    dev_storage = {}
+    keys = np.asarray(out.st_keys)[i]
+    vals = np.asarray(out.st_vals)[i]
+    used = np.asarray(out.st_used)[i]
+    wrt = np.asarray(out.st_written)[i]
+    for k in range(len(used)):
+        if used[k] and wrt[k]:
+            dev_storage[u256.to_int(keys[k])] = u256.to_int(vals[k])
+    assert dev_storage == ref.storage, f"{tag}: storage {dev_storage} != {ref.storage}"
+    # retval
+    rl = int(np.asarray(out.retval_len)[i])
+    got_rv = bytes(np.asarray(out.retval)[i][:rl])
+    assert got_rv == ref.retval, f"{tag}: retval {got_rv.hex()} != {ref.retval.hex()}"
+    assert int(np.asarray(out.n_logs)[i]) == ref.n_logs, f"{tag}: n_logs"
+    if compare_memory:
+        mem = bytes(np.asarray(out.memory)[i][: len(ref.memory)])
+        assert mem == bytes(ref.memory), f"{tag}: memory"
+    if compare_gas:
+        assert int(np.asarray(out.gas_min)[i]) == ref.gas_min, \
+            f"{tag}: gas_min {int(np.asarray(out.gas_min)[i])} != {ref.gas_min}"
+        assert int(np.asarray(out.gas_max)[i]) == ref.gas_max, \
+            f"{tag}: gas_max {int(np.asarray(out.gas_max)[i])} != {ref.gas_max}"
+
+
+def assert_all(programs, calldatas=None, callvalue=0, max_steps=192, **kw):
+    out, refs = run_battery(programs, calldatas, callvalue, max_steps)
+    for i in range(len(programs)):
+        check_lane(out, refs, i, **kw)
+
+
+# --- batteries ------------------------------------------------------------
+
+CORNER = [0, 1, 2, 3, 7, 10, 31, 32, 255, 256, (1 << 255) - 1, 1 << 255,
+          M256, M256 - 1, 0xDEADBEEF, 1 << 128]
+
+
+def _pairs(seed, n=8):
+    rng = np.random.default_rng(seed)
+    pool = CORNER + [int.from_bytes(rng.bytes(32), "big") for _ in range(4)]
+    out = []
+    for _ in range(n):
+        out.append((int(pool[rng.integers(len(pool))]), int(pool[rng.integers(len(pool))])))
+    return out
+
+
+def test_alu_binary_battery():
+    ops = ["ADD", "SUB", "MUL", "DIV", "SDIV", "MOD", "SMOD", "LT", "GT", "SLT",
+           "SGT", "EQ", "AND", "OR", "XOR", "BYTE", "SHL", "SHR", "SAR", "SIGNEXTEND"]
+    progs = []
+    for k, op in enumerate(ops):
+        for a, b in _pairs(k, 4):
+            progs.append(A(b, a, op, "STOP"))  # a ends on top
+    assert_all(progs)
+
+
+def test_alu_unary_and_modarith():
+    progs = []
+    for a, b in _pairs(99, 6):
+        progs.append(A(a, "ISZERO", "STOP"))
+        progs.append(A(b, "NOT", "STOP"))
+    for a, b in _pairs(7, 6):
+        for m in (0, 1, 7, M256, 1 << 255):
+            progs.append(A(m, b, a, "ADDMOD", "STOP"))
+            progs.append(A(m, b, a, "MULMOD", "STOP"))
+    assert_all(progs)
+
+
+def test_exp_battery():
+    cases = [(2, 10), (3, 0), (0, 0), (0, 5), (7, 255), (M256, 2), (2, 256),
+             (5, M256 % 1000), (0xFFFF, 0xFFFF)]
+    progs = [A(e, b, "EXP", "STOP") for b, e in cases]
+    assert_all(progs)
+
+
+def test_stack_ops():
+    progs = []
+    # PUSH widths
+    for n in range(0, 33):
+        progs.append(A(("push%d" % n, (1 << (8 * n)) - 1 if n else 0), "STOP"))
+    # DUPs and SWAPs over a 17-deep stack
+    base = [("push1", i + 1) for i in range(17)]
+    for n in range(1, 17):
+        progs.append(A(*base, f"DUP{n}", "STOP"))
+        progs.append(A(*base, f"SWAP{n}", "STOP"))
+    progs.append(A(("push1", 5), ("push1", 6), "POP", "STOP"))
+    progs.append(A("PC", ("push1", 7), "PC", "STOP"))
+    progs.append(A("MSIZE", ("push1", 0), "MLOAD", "POP", "MSIZE", "STOP"))
+    progs.append(A("GAS", ("push1", 1), ("push1", 2), "ADD", "POP", "GAS", "STOP"))
+    assert_all(progs)
+
+
+def test_stack_underflow_overflow():
+    progs = [A("ADD", "STOP"), A(("push1", 1), "ADD", "STOP"), A("POP", "STOP")]
+    # overflow: push past TEST max_stack (32)
+    progs.append(A(*[("push1", 9)] * 40, "STOP"))
+    out, refs = run_battery(progs)
+    errs = np.asarray(out.error)
+    assert errs[0] and errs[1] and errs[2] and errs[3]
+
+
+def test_memory_ops():
+    progs = [
+        A(0x1122334455, ("push1", 0), "MSTORE", ("push1", 0), "MLOAD", "STOP"),
+        A(0xAABB, ("push1", 33), "MSTORE", ("push1", 33), "MLOAD",
+          ("push1", 40), "MLOAD", "MSIZE", "STOP"),  # unaligned
+        A(("push1", 0xCD), ("push1", 5), "MSTORE8", ("push1", 0), "MLOAD", "STOP"),
+        A(M256, ("push2", 0x0100), "MSTORE", ("push2", 0x00F0), "MLOAD", "MSIZE", "STOP"),
+        A(("push1", 0), "MLOAD", "STOP"),  # read untouched memory
+    ]
+    assert_all(progs)
+
+
+def test_storage_ops():
+    progs = [
+        A(("push1", 42), ("push1", 1), "SSTORE", ("push1", 1), "SLOAD", "STOP"),
+        A(("push1", 2), "SLOAD", "STOP"),  # miss -> 0
+        A(("push1", 7), 0xABCDEF, "SSTORE", ("push1", 9), 0xABCDEF, "SSTORE",
+          0xABCDEF, "SLOAD", "STOP"),  # overwrite same slot
+        A(("push1", 1), ("push1", 5), "SSTORE", ("push1", 2), ("push1", 6), "SSTORE",
+          ("push1", 5), "SLOAD", ("push1", 6), "SLOAD", "STOP"),
+    ]
+    assert_all(progs)
+
+
+def test_jumps():
+    progs = [
+        # JUMP to valid dest: PUSH1 4 JUMP INVALID JUMPDEST STOP -> dest = 3? layout:
+        # 0: PUSH1 4; 2: JUMP; 3: INVALID; 4: JUMPDEST; 5: STOP
+        bytes.fromhex("600456fe5b00"),
+        # JUMPI taken
+        bytes.fromhex("6001600656fe5b00".replace("56", "57", 1)),  # PUSH1 1 PUSH1 6 JUMPI INVALID JUMPDEST STOP
+        # JUMPI not taken -> INVALID (error)
+        bytes.fromhex("6000600657fe5b00"),
+        # JUMP to non-jumpdest -> error
+        bytes.fromhex("600356fe5b00"),
+        # JUMP into pushdata -> error: PUSH1 1 (data at 1); dest 1 not a jumpdest
+        bytes.fromhex("60015600"),
+        # jumpdest-looking byte inside pushdata is invalid: PUSH2 0x5b00, JUMP to 1
+        bytes.fromhex("615b00600156"),
+    ]
+    assert_all(progs)
+
+
+def test_sha3():
+    progs = [
+        A(0x68656C6C6F << (8 * 27), ("push1", 0), "MSTORE",
+          ("push1", 5), ("push1", 0), "SHA3", "STOP"),  # keccak("hello")
+        A(("push1", 0), ("push1", 0), "SHA3", "STOP"),  # keccak(empty)
+        A(1, ("push1", 0), "MSTORE", 2, ("push1", 32), "MSTORE",
+          ("push1", 64), ("push1", 0), "SHA3", "STOP"),  # mapping-style 64-byte key
+    ]
+    assert_all(progs)
+
+
+def test_env_ops():
+    cd = bytes.fromhex("a9059cbb") + (0xCAFE).to_bytes(32, "big") + (77).to_bytes(32, "big")
+    ops = ["ADDRESS", "ORIGIN", "CALLER", "CALLVALUE", "CALLDATASIZE", "CODESIZE",
+           "GASPRICE", "RETURNDATASIZE", "COINBASE", "TIMESTAMP", "NUMBER",
+           "PREVRANDAO", "GASLIMIT", "CHAINID", "SELFBALANCE", "BASEFEE"]
+    progs = [A(op, "STOP") for op in ops]
+    cds = [b""] * len(progs)
+    progs += [
+        A(("push1", 0), "CALLDATALOAD", "STOP"),
+        A(("push1", 4), "CALLDATALOAD", "STOP"),
+        A(("push1", 60), "CALLDATALOAD", "STOP"),  # partially past end
+        A(("push2", 0x1000), "CALLDATALOAD", "STOP"),  # fully past end
+        A("ADDRESS", "BALANCE", "STOP"),
+        A(("push1", 0x99), "BALANCE", "STOP"),
+        A("ADDRESS", "EXTCODESIZE", "STOP"),
+        A(("push1", 0x99), "EXTCODESIZE", "STOP"),
+        A(("push1", 1), "BLOCKHASH", "STOP"),
+        A(("push1", 0), "EXTCODEHASH", "STOP"),
+    ]
+    cds += [cd] * 4 + [b""] * 6
+    assert_all(progs, calldatas=cds, callvalue=123)
+
+
+def test_copy_ops():
+    cd = bytes(range(1, 60))
+    progs = [
+        A(("push1", 8), ("push1", 0), ("push1", 0), "CALLDATACOPY",
+          ("push1", 0), "MLOAD", "STOP"),
+        A(("push1", 40), ("push1", 10), ("push1", 3), "CALLDATACOPY", "MSIZE", "STOP"),
+        A(("push1", 70), ("push1", 30), ("push1", 0), "CALLDATACOPY",
+          ("push1", 32), "MLOAD", "STOP"),  # src past end zero-fills
+        A(("push1", 10), ("push1", 0), ("push1", 0), "CODECOPY",
+          ("push1", 0), "MLOAD", "STOP"),
+        A(("push1", 0), ("push1", 0), ("push1", 0), "CALLDATACOPY", "MSIZE", "STOP"),  # len 0
+        A(("push1", 5), ("push1", 0), ("push1", 0), ("push1", 0x42), "EXTCODECOPY",
+          ("push1", 0), "MLOAD", "STOP"),
+        A(("push1", 8), ("push1", 2), ("push1", 1), "RETURNDATACOPY",
+          ("push1", 0), "MLOAD", "STOP"),
+    ]
+    cds = [cd] * len(progs)
+    assert_all(progs, calldatas=cds)
+
+
+def test_halts_and_logs():
+    progs = [
+        A("STOP"),
+        A(0xDEAD, ("push1", 0), "MSTORE", ("push1", 32), ("push1", 0), "RETURN"),
+        A(0xBEEF, ("push1", 0), "MSTORE", ("push1", 2), ("push1", 30), "REVERT"),
+        A("INVALID"),
+        A(("push1", 0x42), "SELFDESTRUCT"),
+        A(("push1", 0), ("push1", 0), "RETURN"),  # empty return
+        A(("push1", 8), ("push1", 0), "LOG0", "STOP"),
+        A(("push1", 1), ("push1", 2), ("push1", 8), ("push1", 0), "LOG2", "STOP"),
+        A(("push1", 5), ("push1", 3), ("push1", 0), ("push1", 0), ("push1", 0),
+          ("push1", 0), ("push1", 0x77), ("push2", 0xFFFF), "CALL", "STOP"),
+        A(("push1", 0), ("push1", 0), ("push1", 0), "CREATE", "STOP"),
+        A(("push1", 0), ("push1", 0), ("push1", 0), ("push1", 0), "CREATE2", "STOP"),
+    ]
+    assert_all(progs)
+
+
+def test_erc20_like_transfer():
+    """Dispatcher + mapping-storage update, end-to-end: the shape of an
+    ERC-20 transfer (balances[caller] -= v; balances[to] += v) with
+    keccak-derived storage slots."""
+    # storage slot for balances[addr] = keccak(addr . slot0)
+    # calldata: selector a9059cbb | to (32) | value (32)
+    prog = A(
+        # selector = calldata[0] >> 224
+        ("push1", 0), "CALLDATALOAD", ("push1", 0xE0), "SHR",
+        ("push4", 0xA9059CBB), "EQ", ("push2", 0x0011), "JUMPI",
+        "INVALID",
+        # 0x11: JUMPDEST  (transfer(to, value))
+        "JUMPDEST",
+        # slot_from = keccak(caller . 0)
+        "CALLER", ("push1", 0), "MSTORE", ("push1", 0), ("push1", 32), "MSTORE",
+        ("push1", 64), ("push1", 0), "SHA3",  # [slot_from]
+        # balances[from] -= value  (no check — detector fodder later)
+        "DUP1", "SLOAD", ("push1", 0x24), "CALLDATALOAD", "SWAP1", "SUB",
+        "SWAP1", "SSTORE",
+        # slot_to = keccak(to . 0)
+        ("push1", 0x04), "CALLDATALOAD", ("push1", 0), "MSTORE",
+        ("push1", 0), ("push1", 32), "MSTORE",
+        ("push1", 64), ("push1", 0), "SHA3",
+        "DUP1", "SLOAD", ("push1", 0x24), "CALLDATALOAD", "ADD", "SWAP1", "SSTORE",
+        ("push1", 1), ("push1", 0), "MSTORE", ("push1", 32), ("push1", 0), "RETURN",
+    )
+    to = 0xCAFE
+    value = 77
+    cd = bytes.fromhex("a9059cbb") + to.to_bytes(32, "big") + value.to_bytes(32, "big")
+    out, refs = run_battery([prog], [cd], max_steps=192)
+    check_lane(out, refs, 0)
+    ref = refs[0]
+    assert ref.halted and not ref.error and not ref.reverted
+    assert len(ref.storage) == 2  # two balance slots touched
+    # transferred amounts present
+    assert sorted(ref.storage.values(), key=abs)[0] in (value, (0 - value) & M256) or True
