@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Driver benchmark: vectorized EVM superstep throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: the hand-written ERC-20-like contract (bench stand-in for
+BASELINE config 1 — no solc in this image), P lanes each running a
+transfer() call to completion, measured as opcode-steps/sec (lane-steps).
+Baseline: the SAME workload on the in-repo pure-Python reference EVM
+(``tests/pyevm_ref.py``) on one CPU core — the honest stand-in for the
+reference's per-state Python interpreter loop (SURVEY.md §6: the reference
+publishes no numbers; its regime is a single-threaded Python opcode loop).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+import mythril_tpu  # noqa: F401  (enables x64)
+import jax
+import jax.numpy as jnp
+
+from mythril_tpu.config import DEFAULT_LIMITS
+from mythril_tpu.core import run
+from mythril_tpu.disassembler.asm import abi_call
+from mythril_tpu.workloads import (
+    BENCH_CALLER as CALLER,
+    TRANSFER_SELECTOR,
+    erc20_transfer_workload,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+from pyevm_ref import RefEVM, RefEnv  # noqa: E402
+
+P = 4096  # lanes
+MAX_STEPS = 256
+
+
+def build_workload():
+    # every lane: transfer(to=lane_id, amount=0) — amount 0 always succeeds
+    # against zero balances and still walks the full keccak/storage path.
+    return erc20_transfer_workload(P, DEFAULT_LIMITS)
+
+
+def count_ref_steps(code: bytes) -> int:
+    """Steps the reference interpreter takes for one transfer() call."""
+    vm = RefEVM(code, calldata=abi_call(TRANSFER_SELECTOR, 0x1000, 0), env=RefEnv(caller=CALLER))
+    res = vm.run(max_steps=MAX_STEPS)
+    assert res.halted and not res.error and not res.reverted, "bench contract must succeed"
+    return res.steps
+
+
+def bench_cpu_baseline(code: bytes, min_seconds: float = 1.0) -> float:
+    """Pure-Python interpreter lane-steps/sec (one core)."""
+    n, steps, t0 = 0, 0, time.perf_counter()
+    while time.perf_counter() - t0 < min_seconds:
+        vm = RefEVM(code, calldata=abi_call(TRANSFER_SELECTOR, 0x1000 + n, 0), env=RefEnv(caller=CALLER))
+        steps += vm.run(max_steps=MAX_STEPS).steps
+        n += 1
+    return steps / (time.perf_counter() - t0)
+
+
+def main():
+    code, f, env, corpus = build_workload()
+    ref_steps = count_ref_steps(code)
+
+    runner = lambda fr: run(fr, env, corpus, max_steps=MAX_STEPS)  # run() is jitted
+    out = runner(f)  # compile + warm up
+    jax.block_until_ready(out.pc)
+    ok = bool(jnp.all(out.halted & ~out.error & ~out.reverted))
+    if not ok:
+        print(json.dumps({"metric": "lane_steps_per_sec", "value": 0.0,
+                          "unit": "steps/s", "vs_baseline": 0.0, "error": "lanes failed"}))
+        return
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = runner(f)
+    jax.block_until_ready(out.pc)
+    dt = (time.perf_counter() - t0) / reps
+
+    # every lane executes ref_steps real instructions before halting
+    device_steps_per_sec = P * ref_steps / dt
+    cpu_steps_per_sec = bench_cpu_baseline(code)
+
+    print(json.dumps({
+        "metric": "lane_steps_per_sec",
+        "value": round(device_steps_per_sec, 1),
+        "unit": "opcode-steps/s (P=%d lanes, ERC20 transfer)" % P,
+        "vs_baseline": round(device_steps_per_sec / cpu_steps_per_sec, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

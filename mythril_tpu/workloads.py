@@ -1,0 +1,37 @@
+"""Canonical benchmark/dry-run workloads shared by ``bench.py`` and
+``__graft_entry__.py`` (single source of truth for the flagship fixture).
+
+The reference's equivalent fixture is a solc-compiled OpenZeppelin ERC-20
+(BASELINE config 1); with no solc in the image the stand-in is the
+hand-assembled token contract in :mod:`mythril_tpu.disassembler.asm`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .config import LimitsConfig
+from .core import Corpus, make_env, make_frontier
+from .disassembler import ContractImage
+from .disassembler.asm import abi_call, erc20_like
+
+TRANSFER_SELECTOR = 0xA9059CBB
+TRANSFER_CALLDATA_LEN = 68  # 4-byte selector + two 32-byte args
+BENCH_CALLER = 0xDEADBEEF
+
+
+def erc20_transfer_workload(P: int, limits: LimitsConfig):
+    """(code, frontier, env, corpus): P lanes each running transfer(to, 0)."""
+    code = erc20_like()
+    img = ContractImage.from_bytecode(code, limits.max_code)
+    corpus = Corpus.from_images([img])
+    cd = np.zeros((P, limits.calldata_bytes), dtype=np.uint8)
+    for i in range(P):
+        blob = abi_call(TRANSFER_SELECTOR, 0x1000 + i, 0)
+        cd[i, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+    f = make_frontier(
+        P, limits, calldata=cd,
+        calldata_len=np.full(P, TRANSFER_CALLDATA_LEN, dtype=np.int32),
+    )
+    env = make_env(P, caller=BENCH_CALLER)
+    return code, f, env, corpus

@@ -20,6 +20,14 @@ import mythril_tpu  # noqa: E402,F401  (enables x64)
 
 import jax  # noqa: E402
 
+# The axon sitecustomize force-sets jax_platforms="axon,cpu", which overrides
+# the JAX_PLATFORMS env var above — pin the CPU backend programmatically so
+# the 8 virtual host devices actually materialize.
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # backend already initialized by an earlier plugin import
+
 # Persistent compilation cache: the superstep graph is large and this box has
 # one core — cache compiled executables across test runs.
 _CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
