@@ -27,6 +27,8 @@ class LimitsConfig:
     tape_len: int = 512  # symbolic SSA tape nodes per lane
     max_constraints: int = 64  # path-condition slots per lane
     call_depth: int = 4  # saved call contexts per lane
+    call_log: int = 8  # recorded external-call events per lane
+    propagate_every: int = 8  # supersteps between feasibility sweeps
 
     def __post_init__(self):
         assert self.max_stack >= 17  # SWAP16 arity
@@ -48,4 +50,6 @@ TEST_LIMITS = LimitsConfig(
     tape_len=128,
     max_constraints=32,
     call_depth=2,
+    call_log=4,
+    propagate_every=4,
 )

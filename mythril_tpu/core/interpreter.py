@@ -457,6 +457,34 @@ def _storage_lookup(f: Frontier, key):
     return hit, val, slot
 
 
+def storage_alloc(f: Frontier, hit, hit_slot, m_store):
+    """Matching-or-first-free slot for an SSTORE under `m_store`.
+    Returns (onehot bool[P,K] of the written slot, overflow bool[P]).
+    Shared by the concrete and symbolic storage handlers so the
+    allocation/overflow policy can't drift between them."""
+    free = ~f.st_used
+    has_free = jnp.any(free, axis=1)
+    free_slot = jnp.argmax(free, axis=1).astype(I32)
+    target = jnp.where(hit, hit_slot, free_slot)
+    overflow = m_store & ~hit & ~has_free
+    wmask = m_store & ~overflow
+    K = f.st_used.shape[1]
+    onehot = (jnp.arange(K)[None, :] == target[:, None]) & wmask[:, None]
+    return onehot, overflow
+
+
+def validate_jump_dest(f: Frontier, corpus: Corpus, dest_w):
+    """(dest i64[P], valid bool[P]): saturating target + JUMPDEST check.
+    Shared by the concrete and symbolic jump handlers."""
+    dest = u256.to_u64_saturating(dest_w).astype(I64)
+    MC = corpus.code.shape[1]
+    idx = jnp.clip(dest, 0, MC - 1).astype(I32)
+    valid = (dest < MC) & jnp.take_along_axis(
+        corpus.is_jumpdest[f.contract_id], idx[:, None], axis=1
+    )[:, 0]
+    return dest, valid
+
+
 def _h_storage(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     key = _peek(f, 0)
     val = _peek(f, 1)
@@ -467,15 +495,7 @@ def _h_storage(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     loaded = jnp.where(hit[:, None], cur, 0).astype(U32)
     stack = _set_slot(f.stack, f.sp - 1, loaded, m & ~is_store)
 
-    # SSTORE: hit slot or first free slot; cache overflow -> lane error
-    free = ~f.st_used
-    has_free = jnp.any(free, axis=1)
-    free_slot = jnp.argmax(free, axis=1).astype(I32)
-    target = jnp.where(hit, slot, free_slot)
-    overflow = m & is_store & ~hit & ~has_free
-    wmask = m & is_store & ~overflow
-    K = f.st_used.shape[1]
-    onehot = (jnp.arange(K)[None, :] == target[:, None]) & wmask[:, None]
+    onehot, overflow = storage_alloc(f, hit, slot, m & is_store)
     st_keys = jnp.where(onehot[:, :, None], key[:, None, :], f.st_keys)
     st_vals = jnp.where(onehot[:, :, None], val[:, None, :], f.st_vals)
     st_used = f.st_used | onehot
@@ -492,12 +512,7 @@ def _h_jump(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     dest_w = _peek(f, 0)
     cond = _peek(f, 1)
     is_jumpi = op == 0x57
-    dest = u256.to_u64_saturating(dest_w).astype(I64)
-    MC = corpus.code.shape[1]
-    dest_ok_idx = jnp.clip(dest, 0, MC - 1).astype(I32)
-    valid_dest = (dest < MC) & jnp.take_along_axis(
-        corpus.is_jumpdest[f.contract_id], dest_ok_idx[:, None], axis=1
-    )[:, 0]
+    dest, valid_dest = validate_jump_dest(f, corpus, dest_w)
     taken = ~u256.is_zero(cond) | ~is_jumpi  # JUMP always taken
     bad = m & taken & ~valid_dest
     new_pc = jnp.where(taken, dest.astype(I32), old_pc + 1)
@@ -590,8 +605,15 @@ _HANDLERS = [
 # ---------------------------------------------------------------------------
 
 
-def superstep(f: Frontier, env: Env, corpus: Corpus) -> Frontier:
-    """Advance every running lane by one instruction."""
+def prologue(f: Frontier, corpus: Corpus):
+    """Fetch + validate the next instruction for every running lane.
+
+    Returns ``(f, op, run, old_pc)``: frontier with arity/validity traps and
+    base gas applied, the per-lane opcode (STOP past code end), the lanes
+    that execute this step, and the pre-step pc. Shared by the concrete
+    superstep and the symbolic engine (reference: the ``StateTransition``
+    decorator checks in ``mythril/laser/ethereum/instructions.py`` ⚠unv).
+    """
     running = f.running
     MC = corpus.code.shape[1]
     pc_idx = jnp.clip(f.pc, 0, MC - 1)
@@ -599,7 +621,6 @@ def superstep(f: Frontier, env: Env, corpus: Corpus) -> Frontier:
     in_code = f.pc < corpus.code_len[f.contract_id]
     op = jnp.where(running & in_code, op_raw, 0).astype(I32)  # off-end = STOP
 
-    # arity + validity traps (reference: StateTransition decorator checks)
     sin = _J_STACK_IN[op]
     sout = _J_STACK_OUT[op]
     bad = running & (
@@ -608,14 +629,20 @@ def superstep(f: Frontier, env: Env, corpus: Corpus) -> Frontier:
     f = f.replace(error=f.error | bad)
     run = running & ~bad
 
-    # base gas from tables
     f = f.replace(
         gas_min=f.gas_min + jnp.where(run, _J_GAS_MIN[op], 0),
         gas_max=f.gas_max + jnp.where(run, _J_GAS_MAX[op], 0),
     )
+    return f, op, run, f.pc
 
-    old_pc = f.pc
+
+def dispatch(f: Frontier, env: Env, corpus: Corpus, op, run, old_pc,
+             skip=None) -> Frontier:
+    """Run the per-class handlers over the frontier. ``skip`` masks lanes
+    out of concrete handling (the symbolic engine claims them)."""
     cls = _J_CLASS[op]
+    if skip is not None:
+        run = run & ~skip
     for cid, handler in enumerate(_HANDLERS):
         mask = run & (cls == cid)
         f = lax.cond(
@@ -624,15 +651,24 @@ def superstep(f: Frontier, env: Env, corpus: Corpus) -> Frontier:
             lambda fr: fr,
             f,
         )
+    return f
 
-    # default pc advance for lanes the handlers didn't redirect/halt
+
+def epilogue(f: Frontier, op, run, old_pc) -> Frontier:
+    """Default pc advance + out-of-gas trap after the handlers ran."""
+    cls = _J_CLASS[op]
     advanced = run & (cls != CLS_JUMP) & ~f.halted & ~f.error
     next_pc = old_pc + 1 + _J_PUSH_WIDTH[op]
     f = f.replace(pc=jnp.where(advanced, next_pc, f.pc))
-
-    # out-of-gas trap (min-gas accounting exceeding the limit)
     oog = run & (f.gas_min > f.gas_limit)
     return f.replace(error=f.error | oog)
+
+
+def superstep(f: Frontier, env: Env, corpus: Corpus) -> Frontier:
+    """Advance every running lane by one instruction."""
+    f, op, run, old_pc = prologue(f, corpus)
+    f = dispatch(f, env, corpus, op, run, old_pc)
+    return epilogue(f, op, run, old_pc)
 
 
 @functools.partial(jax.jit, static_argnames=("max_steps",))

@@ -1,0 +1,22 @@
+"""Symbolic execution layer: SSA tape, forking engine, constraint propagation.
+
+TPU-first replacement for the reference's Z3-object symbolic state
+(``mythril/laser/smt`` + symbolic values threaded through
+``mythril/laser/ethereum/state`` ⚠unv, SURVEY.md §2): symbolic values are
+integer node ids into a per-lane bounded SSA tape; path conditions are
+(node, sign) pairs; feasibility is decided by batched abstract
+interpretation over the tape (known-bits + unsigned intervals), with a
+model-search fallback instead of Z3 (not available in this image).
+"""
+
+from .ops import SymOp, FreeKind, WELL_KNOWN, N_WELL_KNOWN, calldata_arg_offsets
+from .state import SymFrontier, make_sym_frontier, SymSpec
+from .engine import sym_superstep, sym_run, expand_forks, append_node
+from .propagate import propagate_feasibility, kill_infeasible
+
+__all__ = [
+    "SymOp", "FreeKind", "WELL_KNOWN", "N_WELL_KNOWN", "calldata_arg_offsets",
+    "SymFrontier", "make_sym_frontier", "SymSpec",
+    "sym_superstep", "sym_run", "expand_forks", "append_node",
+    "propagate_feasibility", "kill_infeasible",
+]

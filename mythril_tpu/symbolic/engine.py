@@ -1,0 +1,771 @@
+"""The symbolic superstep: concrete dispatch + sym-id overlay + forking.
+
+Counterpart of the reference's symbolic ``Instruction.evaluate`` over Z3
+expressions and ``jumpi_``'s state forking
+(``mythril/laser/ethereum/instructions.py`` ⚠unv, SURVEY.md §3.2), but
+frontier-first:
+
+- lanes whose current opcode touches symbolic control/addresses are
+  *claimed* out of the concrete dispatch and handled by sym-aware
+  handlers (storage, jumps, calls, symbolic-offset memory ops);
+- everything else runs the concrete handler unchanged, and a vectorized
+  overlay keeps ``stack_sym``/``mem_sym`` in sync and appends tape nodes;
+- a symbolic JUMPI records a fork request; :func:`expand_forks` performs
+  masked lane duplication + prefix-sum compaction into free lanes
+  (the reference's ``work_list.append`` of forked GlobalStates).
+
+Over-approximation policy: wherever byte-exact symbolic tracking is not
+worth the shapes (unaligned accesses, symbolic offsets, ADDMOD), the
+result is a fresh unconstrained HAVOC leaf — never a wrong value, so the
+engine may explore infeasible paths but never misses feasible ones.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import LimitsConfig, DEFAULT_LIMITS
+from ..core import interpreter as ci
+from ..core.frontier import Frontier, Env, Corpus
+from ..ops import u256
+from .ops import (
+    SymOp, FreeKind, calldata_arg_offsets,
+    WK_CALLER, WK_CALLVALUE, WK_CALLDATASIZE, WK_ORIGIN, WK_TIMESTAMP,
+    WK_NUMBER, WK_BALANCE, WK_GASPRICE, WK_PREVRANDAO, WK_CALLDATA0,
+)
+from .state import SymFrontier, SymSpec
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+
+# EVM opcode -> SymOp for plain binary/unary value ops (0 = no mapping)
+def _binop_table() -> np.ndarray:
+    t = np.zeros(256, dtype=np.int32)
+    m = {
+        0x01: SymOp.ADD, 0x02: SymOp.MUL, 0x03: SymOp.SUB, 0x04: SymOp.DIV,
+        0x05: SymOp.SDIV, 0x06: SymOp.MOD, 0x07: SymOp.SMOD, 0x0A: SymOp.EXP,
+        0x0B: SymOp.SIGNEXTEND, 0x10: SymOp.LT, 0x11: SymOp.GT,
+        0x12: SymOp.SLT, 0x13: SymOp.SGT, 0x14: SymOp.EQ, 0x15: SymOp.ISZERO,
+        0x16: SymOp.AND, 0x17: SymOp.OR, 0x18: SymOp.XOR, 0x19: SymOp.NOT,
+        0x1A: SymOp.BYTE, 0x1B: SymOp.SHL, 0x1C: SymOp.SHR, 0x1D: SymOp.SAR,
+    }
+    for k, v in m.items():
+        t[k] = int(v)
+    return t
+
+
+_J_BINOP = jnp.asarray(_binop_table())
+
+
+# ---------------------------------------------------------------------------
+# Tape + sym-stack helpers
+# ---------------------------------------------------------------------------
+
+
+def _peek_sym(sf: SymFrontier, i) -> jnp.ndarray:
+    sp = sf.base.sp
+    S = sf.stack_sym.shape[1]
+    idx = jnp.clip(sp - 1 - i, 0, S - 1)
+    return jnp.take_along_axis(sf.stack_sym, idx[:, None].astype(I32), axis=1)[:, 0]
+
+
+def _set_sym_slot(stack_sym, pos, val, mask):
+    S = stack_sym.shape[1]
+    sel = (jnp.arange(S)[None, :] == pos[:, None]) & mask[:, None]
+    return jnp.where(sel, val[:, None], stack_sym)
+
+
+def append_node(sf: SymFrontier, mask, op, a, b, imm=None):
+    """Hash-consed tape append. op/a/b scalar or i32[P]; imm u32[P,8]|None.
+    Returns (sf, ids) — id per lane (0 where ~mask). Overflow errors lane."""
+    P, T = sf.tape_op.shape
+    op = jnp.broadcast_to(jnp.asarray(op, I32), (P,))
+    a = jnp.broadcast_to(jnp.asarray(a, I32), (P,))
+    b = jnp.broadcast_to(jnp.asarray(b, I32), (P,))
+    if imm is None:
+        imm = jnp.zeros((P, 8), dtype=U32)
+    live = jnp.arange(T)[None, :] < sf.tape_len[:, None]
+    match = (
+        live
+        & (sf.tape_op == op[:, None])
+        & (sf.tape_a == a[:, None])
+        & (sf.tape_b == b[:, None])
+        & jnp.all(sf.tape_imm == imm[:, None, :], axis=-1)
+    )
+    hit = jnp.any(match, axis=1)
+    hit_id = jnp.argmax(match, axis=1).astype(I32)
+    overflow = mask & ~hit & (sf.tape_len >= T)
+    write = mask & ~hit & ~overflow
+    onehot = (jnp.arange(T)[None, :] == sf.tape_len[:, None]) & write[:, None]
+    ids = jnp.where(mask, jnp.where(hit, hit_id, jnp.where(write, sf.tape_len, 0)), 0)
+    return (
+        sf.replace(
+            tape_op=jnp.where(onehot, op[:, None], sf.tape_op),
+            tape_a=jnp.where(onehot, a[:, None], sf.tape_a),
+            tape_b=jnp.where(onehot, b[:, None], sf.tape_b),
+            tape_imm=jnp.where(onehot[:, :, None], imm[:, None, :], sf.tape_imm),
+            tape_len=sf.tape_len + write.astype(I32),
+            base=sf.base.replace(error=sf.base.error | overflow),
+        ),
+        ids,
+    )
+
+
+def _sym_or_const(sf: SymFrontier, mask, sym, limbs):
+    """Operand id: existing sym, id 0 for concrete zero, CONST node else."""
+    need = mask & (sym == 0) & ~u256.is_zero(limbs)
+    sf, cid = append_node(sf, need, int(SymOp.CONST), 0, 0, limbs)
+    return sf, jnp.where(sym != 0, sym, cid)
+
+
+def _havoc(sf: SymFrontier, mask):
+    """Fresh unconstrained leaf per lane (unique via per-lane counter)."""
+    sf2, ids = append_node(
+        sf, mask, int(SymOp.FREE), int(FreeKind.HAVOC), sf.havoc_cnt
+    )
+    return sf2.replace(havoc_cnt=sf2.havoc_cnt + mask.astype(I32)), ids
+
+
+def _lookup_constraint(sf: SymFrontier, node):
+    """Is `node` already asserted on the path? -> (known, sign)."""
+    C = sf.con_node.shape[1]
+    live = jnp.arange(C)[None, :] < sf.con_len[:, None]
+    m = live & (sf.con_node == node[:, None]) & (node[:, None] != 0)
+    known = jnp.any(m, axis=1)
+    idx = jnp.argmax(m, axis=1)
+    sign = jnp.take_along_axis(sf.con_sign, idx[:, None], axis=1)[:, 0]
+    return known, known & sign
+
+
+def _append_constraint(sf: SymFrontier, mask, node, sign):
+    C = sf.con_node.shape[1]
+    overflow = mask & (sf.con_len >= C)
+    write = mask & ~overflow
+    onehot = (jnp.arange(C)[None, :] == sf.con_len[:, None]) & write[:, None]
+    sign = jnp.broadcast_to(jnp.asarray(sign, bool), mask.shape)
+    return sf.replace(
+        con_node=jnp.where(onehot, node[:, None], sf.con_node),
+        con_sign=jnp.where(onehot, sign[:, None], sf.con_sign),
+        con_len=sf.con_len + write.astype(I32),
+        base=sf.base.replace(error=sf.base.error | overflow),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Claimed handlers: sym-aware replacements run after the concrete dispatch
+# (their lanes were skipped there, so stack/sp are still pre-instruction)
+# ---------------------------------------------------------------------------
+
+
+def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
+    """SLOAD/SSTORE with (possibly symbolic) keys and values.
+
+    Key matching is syntactic: concrete keys match by limb equality,
+    symbolic keys by tape node id (hash-consing makes structurally equal
+    keccak keys share an id — the analog of the reference's
+    KeccakFunctionManager hash-linking ⚠unv). Distinct node ids are
+    treated as distinct slots; numeric aliasing between them is missed.
+    """
+    f = sf.base
+    key = ci._peek(f, 0)
+    key_sym = _peek_sym(sf, 0)
+    val = ci._peek(f, 1)
+    val_sym = _peek_sym(sf, 1)
+    is_store = op == 0x55
+
+    conc = (key_sym[:, None] == 0) & (sf.st_key_sym == 0) & jnp.all(
+        f.st_keys == key[:, None, :], axis=-1
+    )
+    symm = (key_sym[:, None] != 0) & (sf.st_key_sym == key_sym[:, None])
+    match = f.st_used & (conc | symm)
+    hit = jnp.any(match, axis=1)
+    cur = jnp.sum(jnp.where(match[:, :, None], f.st_vals, 0), axis=1).astype(U32)
+    cur_sym = jnp.sum(jnp.where(match, sf.st_val_sym, 0), axis=1).astype(I32)
+
+    # SLOAD miss -> fresh STORAGE leaf (hash-consed on key, so repeated
+    # loads of the same key agree); concrete-zero when storage isn't symbolic
+    miss_load = m & ~is_store & ~hit
+    if spec.storage:
+        sf, leaf = append_node(
+            sf, miss_load, int(SymOp.FREE), int(FreeKind.STORAGE), key_sym,
+            jnp.where((key_sym == 0)[:, None], key, 0).astype(U32),
+        )
+    else:
+        leaf = jnp.zeros_like(key_sym)
+    f = sf.base
+    loaded = jnp.where(hit[:, None], cur, 0).astype(U32)
+    loaded_sym = jnp.where(hit, cur_sym, leaf)
+    stack = ci._set_slot(f.stack, f.sp - 1, loaded, m & ~is_store)
+    stack_sym = _set_sym_slot(sf.stack_sym, f.sp - 1, loaded_sym, m & ~is_store)
+
+    # SSTORE into matching-or-free slot (shared alloc policy with the
+    # concrete handler)
+    slot_id = jnp.argmax(match, axis=1).astype(I32)
+    onehot, overflow = ci.storage_alloc(f, hit, slot_id, m & is_store)
+    return sf.replace(
+        base=f.replace(
+            stack=stack,
+            sp=jnp.where(m & is_store, f.sp - 2, f.sp),
+            st_keys=jnp.where(onehot[:, :, None], key[:, None, :], f.st_keys),
+            st_vals=jnp.where(onehot[:, :, None], val[:, None, :], f.st_vals),
+            st_used=f.st_used | onehot,
+            st_written=f.st_written | onehot,
+            error=f.error | overflow,
+        ),
+        stack_sym=stack_sym,
+        st_key_sym=jnp.where(onehot, key_sym[:, None], sf.st_key_sym),
+        st_val_sym=jnp.where(onehot, val_sym[:, None], sf.st_val_sym),
+    )
+
+
+def _h_sym_jump(sf: SymFrontier, corpus: Corpus, op, m, old_pc, known, ksign) -> SymFrontier:
+    """JUMP/JUMPI with symbolic dest and/or condition.
+
+    - symbolic unknown condition + concrete valid dest: record a fork
+      request (taken branch materialized by expand_forks) and continue on
+      the fallthrough with ¬cond appended to the path condition
+      (reference: ``jumpi_`` returning two states ⚠unv);
+    - condition already asserted on this path: no fork, follow it;
+    - symbolic dest on a (possibly) taken branch: record the node for the
+      ArbitraryJump detector (SWC-127) and halt that branch.
+    """
+    f = sf.base
+    dest_w = ci._peek(f, 0)
+    dest_sym = _peek_sym(sf, 0)
+    cond = ci._peek(f, 1)
+    cond_sym = _peek_sym(sf, 1)
+    is_jumpi = op == 0x57
+
+    dest, valid_dest = ci.validate_jump_dest(f, corpus, dest_w)
+    valid_dest = valid_dest & (dest_sym == 0)
+
+    cond_is_sym = is_jumpi & (cond_sym != 0)
+    resolved = ~is_jumpi | ~cond_is_sym | known
+    taken_res = jnp.where(
+        is_jumpi,
+        jnp.where(cond_is_sym, ksign, ~u256.is_zero(cond)),
+        True,
+    )
+
+    m_res = m & resolved
+    m_fork = m & ~resolved
+    # resolved, taken, symbolic dest -> SWC-127 record + halt
+    sym_taken = m_res & taken_res & (dest_sym != 0)
+    conc_taken = m_res & taken_res & (dest_sym == 0)
+    bad = conc_taken & ~valid_dest
+    # unresolved, symbolic dest: fallthrough survives; record the finding
+    sym_unres = m_fork & (dest_sym != 0)
+    # A concrete-but-invalid dest means the taken branch is an exceptional
+    # halt (the concrete engine traps it); it is intentionally not forked —
+    # matching the reference, which kills invalid-jump successors. The
+    # fork also requires the ¬cond constraint write to succeed: a copy
+    # whose sign-flip would hit an unrelated constraint slot would carry a
+    # corrupted path condition.
+    con_ok = sf.con_len < sf.con_node.shape[1]
+    fork_ok = m_fork & valid_dest & con_ok
+    sf = _append_constraint(sf, m_fork, cond_sym, False)
+
+    f = sf.base
+    new_pc = jnp.where(m_res & conc_taken, dest.astype(I32), old_pc + 1)
+    move = (m_res & ~bad & ~sym_taken) | m_fork
+    d_sp = jnp.where(is_jumpi, 2, 1)
+    return sf.replace(
+        base=f.replace(
+            pc=jnp.where(move, new_pc, f.pc),
+            sp=jnp.where(m, f.sp - d_sp, f.sp),
+            error=f.error | bad,
+            halted=f.halted | sym_taken,
+        ),
+        sym_jump_dest=jnp.where(sym_taken | sym_unres, dest_sym, sf.sym_jump_dest),
+        fork_req=sf.fork_req | fork_ok,
+        fork_dest=jnp.where(fork_ok, dest.astype(I32), sf.fork_dest),
+    )
+
+
+def _h_sym_callish(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
+    """CALL family + CREATE/CREATE2: record the event for detection
+    modules, push a fresh symbolic return value (reference: ``call_``
+    raising TransactionStartSignal; sub-tx semantics arrive with the
+    transaction layer)."""
+    f = sf.base
+    is_create = (op == 0xF0) | (op == 0xF5)
+    has_value = (op == 0xF1) | (op == 0xF2)  # CALL, CALLCODE
+    sin = ci._J_STACK_IN[op]
+
+    to = ci._peek(f, 1)
+    to_sym = _peek_sym(sf, 1)
+    v_call = ci._peek(f, 2)
+    v_call_sym = _peek_sym(sf, 2)
+    v_create = ci._peek(f, 0)
+    v_create_sym = _peek_sym(sf, 0)
+    value = jnp.where(is_create[:, None], v_create, jnp.where(has_value[:, None], v_call, 0)).astype(U32)
+    value_sym = jnp.where(is_create, v_create_sym, jnp.where(has_value, v_call_sym, 0))
+    to_rec = jnp.where(is_create[:, None], 0, to).astype(U32)
+    to_sym_rec = jnp.where(is_create, 0, to_sym)
+
+    # output region havoc (call writes returndata into memory)
+    out_len = jnp.where(has_value[:, None], ci._peek(f, 6), ci._peek(f, 5))
+    out_len_sym = jnp.where(has_value, _peek_sym(sf, 6), _peek_sym(sf, 5))
+    havoc_mem = m & ~is_create & ((out_len_sym != 0) | ~u256.is_zero(out_len))
+
+    CL = sf.call_to.shape[1]
+    idx = jnp.minimum(sf.n_calls, CL - 1)
+    rec = m & (sf.n_calls < CL)
+    onehot = (jnp.arange(CL)[None, :] == idx[:, None]) & rec[:, None]
+
+    sf, rv = append_node(sf, m, int(SymOp.FREE), int(FreeKind.RETVAL), sf.n_calls)
+    f = sf.base
+    dest_slot = f.sp - sin
+    zero_w = jnp.zeros_like(to)
+    return sf.replace(
+        base=f.replace(
+            stack=ci._set_slot(f.stack, dest_slot, zero_w, m),
+            sp=jnp.where(m, f.sp - sin + 1, f.sp),
+            returndata_len=jnp.where(m, 0, f.returndata_len),
+        ),
+        stack_sym=_set_sym_slot(sf.stack_sym, dest_slot, rv, m),
+        mem_havoc=sf.mem_havoc | havoc_mem,
+        retdata_sym=sf.retdata_sym | (m & ~is_create),
+        n_calls=sf.n_calls + m.astype(I32),
+        call_to=jnp.where(onehot[:, :, None], to_rec[:, None, :], sf.call_to),
+        call_to_sym=jnp.where(onehot, to_sym_rec[:, None], sf.call_to_sym),
+        call_value=jnp.where(onehot[:, :, None], value[:, None, :], sf.call_value),
+        call_value_sym=jnp.where(onehot, value_sym[:, None], sf.call_value_sym),
+        call_op=jnp.where(onehot, op[:, None], sf.call_op),
+        call_pc=jnp.where(onehot, old_pc[:, None], sf.call_pc),
+    )
+
+
+def _h_sym_claimed_misc(sf: SymFrontier, op, m_memoff, m_sha3off, m_copyoff,
+                        m_haltoff, m_logoff) -> SymFrontier:
+    """Symbolic-offset memory/copy/sha3/halt/log ops: stack bookkeeping +
+    havoc over-approximation (no byte-accurate modeling at symbolic
+    addresses under static shapes)."""
+    f = sf.base
+    is_load = op == 0x51
+    any_m = m_memoff | m_sha3off | m_copyoff | m_haltoff | m_logoff
+
+    # MLOAD(sym off) / SHA3(sym args) -> fresh havoc result
+    need_hv = (m_memoff & is_load) | m_sha3off
+    sf, hv = _havoc(sf, need_hv)
+    f = sf.base
+
+    # result slots: MLOAD replaces top (sp-1); SHA3 pops 2 pushes 1 (sp-2)
+    stack_sym = _set_sym_slot(sf.stack_sym, f.sp - 1, hv, m_memoff & is_load)
+    stack_sym = _set_sym_slot(stack_sym, f.sp - 2, hv, m_sha3off)
+
+    sin = ci._J_STACK_IN[op]
+    sout = ci._J_STACK_OUT[op]
+    d_sp = sin - sout
+    is_revert = op == 0xFD
+    has_data_halt = (op == 0xF3) | is_revert
+    return sf.replace(
+        base=f.replace(
+            sp=jnp.where(any_m, f.sp - d_sp, f.sp),
+            halted=f.halted | (m_haltoff & has_data_halt),
+            reverted=f.reverted | (m_haltoff & is_revert),
+            retval_len=jnp.where(m_haltoff, 0, f.retval_len),
+            n_logs=f.n_logs + m_logoff.astype(I32),
+        ),
+        stack_sym=stack_sym,
+        # symbolic-offset stores / copies invalidate the whole memory overlay
+        mem_havoc=sf.mem_havoc | (m_memoff & ~is_load) | m_copyoff,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Overlay: sym-id bookkeeping for concretely-dispatched lanes
+# ---------------------------------------------------------------------------
+
+
+def _take_word_sym(mem_sym, w):
+    W = mem_sym.shape[1]
+    return jnp.take_along_axis(mem_sym, jnp.clip(w, 0, W - 1)[:, None].astype(I32), axis=1)[:, 0]
+
+
+def _set_word_sym(mem_sym, w, val, mask):
+    W = mem_sym.shape[1]
+    sel = (jnp.arange(W)[None, :] == w[:, None]) & mask[:, None] & (w[:, None] < W) & (w[:, None] >= 0)
+    return jnp.where(sel, val[:, None], mem_sym)
+
+
+def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
+             pre_stack_sym, a, s, limits: LimitsConfig) -> SymFrontier:
+    """Mirror the concrete handlers' stack movements on the sym-id plane
+    and append tape nodes where symbolic operands flowed in. Uses the
+    PRE-dispatch stack/syms (`a` = operand limbs, `s` = operand sym ids).
+    """
+    f = sf.base
+    stack_sym = sf.stack_sym
+    sin = ci._J_STACK_IN[op]
+
+    # ---- CLS_STACK: push/dup/swap/pc/msize/gas ----
+    m_stk = m & (cls == ci.CLS_STACK)
+    is_push = (op >= 0x5F) & (op <= 0x7F)
+    is_dup = (op >= 0x80) & (op <= 0x8F)
+    is_swap = (op >= 0x90) & (op <= 0x9F)
+    pushes0 = is_push | (op == 0x58) | (op == 0x59) | (op == 0x5A)
+    dup_n = jnp.where(is_dup, op - 0x7F, 1)
+    S = stack_sym.shape[1]
+    dup_sym = jnp.take_along_axis(
+        pre_stack_sym, jnp.clip(pre_sp - dup_n, 0, S - 1)[:, None].astype(I32), axis=1
+    )[:, 0]
+    stack_sym = _set_sym_slot(stack_sym, pre_sp, jnp.zeros_like(dup_sym), m_stk & pushes0)
+    stack_sym = _set_sym_slot(stack_sym, pre_sp, dup_sym, m_stk & is_dup)
+    swap_n = jnp.where(is_swap, op - 0x8F, 1)
+    deep_sym = jnp.take_along_axis(
+        pre_stack_sym, jnp.clip(pre_sp - 1 - swap_n, 0, S - 1)[:, None].astype(I32), axis=1
+    )[:, 0]
+    stack_sym = _set_sym_slot(stack_sym, pre_sp - 1, deep_sym, m_stk & is_swap)
+    stack_sym = _set_sym_slot(stack_sym, pre_sp - 1 - swap_n, s[0], m_stk & is_swap)
+    sf = sf.replace(stack_sym=stack_sym)
+
+    # ---- value binops/unaries (ALU/MUL/DIVMOD/EXP classes) ----
+    m_bin = m & (
+        (cls == ci.CLS_ALU) | (cls == ci.CLS_MUL) | (cls == ci.CLS_DIVMOD) | (cls == ci.CLS_EXP)
+    )
+    node_op = _J_BINOP[op]
+    is_unary = (op == 0x15) | (op == 0x19)  # ISZERO NOT
+    any_sym = (s[0] != 0) | (~is_unary & (s[1] != 0))
+    m_node = m_bin & any_sym & (node_op != 0)
+    sf, aid = _sym_or_const(sf, m_node, s[0], a[0])
+    sf, bid = _sym_or_const(sf, m_node & ~is_unary, s[1], a[1])
+    bid = jnp.where(is_unary, 0, bid)  # unary nodes must not carry stale b
+    sf, r_bin = append_node(sf, m_node, node_op, aid, bid)
+
+    # ---- CLS_MODARITH: symbolic addmod/mulmod -> havoc (documented) ----
+    m_mod = m & (cls == ci.CLS_MODARITH)
+    m_mod_sym = m_mod & ((s[0] != 0) | (s[1] != 0) | (s[2] != 0))
+
+    # ---- CLS_ENV: leaves ----
+    m_env = m & (cls == ci.CLS_ENV)
+    off64 = u256.to_u64_saturating(a[0]).astype(I64)
+    CD = limits.calldata_bytes
+    n_args = len(calldata_arg_offsets(CD)) - 1
+    arg_i = (off64 - 4) // 32
+    wk_cd = jnp.where(
+        off64 == 0,
+        WK_CALLDATA0,
+        jnp.where(
+            (off64 >= 4) & ((off64 - 4) % 32 == 0) & (arg_i < n_args),
+            WK_CALLDATA0 + 1 + arg_i.astype(I32),
+            0,
+        ),
+    ).astype(I32)
+    is_cdload = op == 0x35
+    beyond = off64 >= CD
+    need_dyn = m_env & is_cdload & (s[0] == 0) & (wk_cd == 0) & ~beyond & spec.calldata
+    sf, dyn_cd = append_node(
+        sf, need_dyn, int(SymOp.FREE), int(FreeKind.CALLDATA_WORD), off64.astype(I32)
+    )
+    is_balance = op == 0x31
+    self_query = u256.eq(a[0], env.address) & (s[0] == 0)
+    bal_self = is_balance & self_query
+    # EXTCODESIZE/EXTCODEHASH of anything but a concrete self-address is
+    # unknown until world-state integration: havoc, NOT concrete 0 — a
+    # wrong concrete value would silently prune feasible branches
+    # (isContract checks).
+    ext_query = (op == 0x3B) | (op == 0x3F)
+    is_rds = op == 0x3D  # RETURNDATASIZE after a symbolic call
+    env_hv_need = m_env & (
+        (is_cdload & (s[0] != 0))
+        | (is_balance & ~bal_self)
+        | (op == 0x40)  # BLOCKHASH
+        | (ext_query & ~self_query)
+    )
+    sf, env_hv = _havoc(sf, env_hv_need)
+    sf, rds_leaf = append_node(
+        sf, m_env & is_rds & sf.retdata_sym,
+        int(SymOp.FREE), int(FreeKind.RETDATASIZE),
+        jnp.maximum(sf.n_calls - 1, 0),
+    )
+
+    def wk(flag: bool, wid: int):
+        return wid if flag else 0
+
+    r_env = jnp.zeros_like(op)
+    r_env = jnp.where(op == 0x33, wk(spec.caller, WK_CALLER), r_env)
+    r_env = jnp.where(op == 0x32, wk(spec.caller, WK_ORIGIN), r_env)
+    r_env = jnp.where(op == 0x34, wk(spec.callvalue, WK_CALLVALUE), r_env)
+    r_env = jnp.where(op == 0x36, wk(spec.calldata, WK_CALLDATASIZE), r_env)
+    r_env = jnp.where(op == 0x42, wk(spec.block_env, WK_TIMESTAMP), r_env)
+    r_env = jnp.where(op == 0x43, wk(spec.block_env, WK_NUMBER), r_env)
+    r_env = jnp.where(op == 0x44, wk(spec.block_env, WK_PREVRANDAO), r_env)
+    r_env = jnp.where(op == 0x3A, wk(spec.block_env, WK_GASPRICE), r_env)
+    r_env = jnp.where(op == 0x47, wk(spec.block_env, WK_BALANCE), r_env)
+    r_env = jnp.where(bal_self, wk(spec.block_env, WK_BALANCE), r_env)
+    if spec.calldata:
+        r_cd = jnp.where(s[0] != 0, env_hv, jnp.where(wk_cd != 0, wk_cd, jnp.where(beyond, 0, dyn_cd)))
+        r_env = jnp.where(is_cdload, r_cd, r_env)
+    else:
+        r_env = jnp.where(is_cdload & (s[0] != 0), env_hv, r_env)
+    r_env = jnp.where(env_hv_need & ~is_cdload, env_hv, r_env)
+    r_env = jnp.where(is_rds & sf.retdata_sym, rds_leaf, r_env)
+
+    # ---- CLS_SHA3 (concrete args): keccak chain over the hashed window ----
+    m_sha = m & (cls == ci.CLS_SHA3)
+    ln64 = u256.to_u64_saturating(a[1]).astype(I64)
+    w0 = (off64 // 32).astype(I32)
+    nw = jnp.clip((off64 % 32 + ln64 + 31) // 32, 0, 8).astype(I32)
+    wsyms = [
+        _take_word_sym(sf.mem_sym, w0 + k) for k in range(8)
+    ]
+    in_win = [(jnp.int32(k) < nw) for k in range(8)]
+    any_w_sym = jnp.zeros_like(m_sha)
+    for k in range(8):
+        any_w_sym = any_w_sym | (in_win[k] & (wsyms[k] != 0))
+    m_hvsha = m_sha & sf.mem_havoc & (ln64 > 0)
+    m_chain = m_sha & any_w_sym & ~sf.mem_havoc
+    sf, sha_hv = _havoc(sf, m_hvsha)
+    seed_imm = jnp.zeros((f.pc.shape[0], 8), dtype=U32)
+    seed_imm = seed_imm.at[:, 0].set(jnp.clip(ln64, 0, 2**31).astype(U32))
+    seed_imm = seed_imm.at[:, 1].set((off64 % 32).astype(U32))
+    sf, chain = append_node(sf, m_chain, int(SymOp.KECCAK_SEED), 0, 0, seed_imm)
+    M = f.memory.shape[1]
+    for k in range(8):
+        mk = m_chain & in_win[k]
+        w_conc = ci._be_bytes_to_word(
+            ci._gather_bytes(sf.base.memory, (w0 + k).astype(I64) * 32, 32,
+                             jnp.full_like(off64, M))
+        )
+        imm_k = jnp.where((wsyms[k] == 0)[:, None], w_conc, 0).astype(U32)
+        sf, chain2 = append_node(sf, mk, int(SymOp.KECCAK_ABS), chain, wsyms[k], imm_k)
+        chain = jnp.where(mk, chain2, chain)
+    sf, dig = append_node(sf, m_chain, int(SymOp.KECCAK), chain, 0)
+    r_sha = jnp.where(m_hvsha, sha_hv, jnp.where(m_chain, dig, 0))
+
+    # ---- CLS_MEM (concrete offsets) ----
+    m_mem = m & (cls == ci.CLS_MEM)
+    is_load = op == 0x51
+    is_store8 = op == 0x53
+    aligned = (off64 % 32) == 0
+    wm = (off64 // 32).astype(I32)
+    wsym_a = _take_word_sym(sf.mem_sym, wm)
+    wsym_b = _take_word_sym(sf.mem_sym, wm + 1)
+    # MLOAD
+    load_sym_needed = m_mem & is_load & (
+        (aligned & ((wsym_a != 0) | sf.mem_havoc))
+        | (~aligned & ((wsym_a != 0) | (wsym_b != 0) | sf.mem_havoc))
+    )
+    hv_load_need = load_sym_needed & (~aligned | sf.mem_havoc)
+    # unaligned MSTORE: havoc both covered words if anything symbolic
+    st_mask = m_mem & ~is_load
+    un_any = st_mask & ~is_store8 & ~aligned & (
+        (s[1] != 0) | (wsym_a != 0) | (wsym_b != 0) | sf.mem_havoc
+    )
+    sf, hv_a = _havoc(sf, hv_load_need | un_any)
+    r_mload = jnp.where(
+        load_sym_needed, jnp.where(aligned & ~sf.mem_havoc, wsym_a, hv_a), 0
+    )
+    mstore_aligned = st_mask & ~is_store8 & aligned
+    mem_sym = _set_word_sym(sf.mem_sym, wm, s[1], mstore_aligned)
+    sf, hv_b = _havoc(sf, un_any)
+    mem_sym = _set_word_sym(mem_sym, wm, hv_a, un_any)
+    mem_sym = _set_word_sym(mem_sym, wm + 1, hv_b, un_any)
+    # MSTORE8: havoc the word if value or word symbolic
+    m8_any = st_mask & is_store8 & ((s[1] != 0) | (wsym_a != 0) | sf.mem_havoc)
+    sf, hv_c = _havoc(sf, m8_any)
+    mem_sym = _set_word_sym(mem_sym, wm, hv_c, m8_any)
+    sf = sf.replace(mem_sym=mem_sym)
+
+    # ---- CLS_COPY (concrete args) ----
+    m_cp = m & (cls == ci.CLS_COPY)
+    is_ext = op == 0x3C
+    dst64 = jnp.where(is_ext, u256.to_u64_saturating(a[1]), off64).astype(I64)
+    cln64 = u256.to_u64_saturating(jnp.where(is_ext[:, None], a[3], a[2])).astype(I64)
+    is_cdcopy = op == 0x37
+    is_rdcopy = op == 0x3E
+    # calldatacopy of symbolic calldata / returndatacopy after a symbolic
+    # call: coarse whole-memory havoc (v1)
+    cd_havoc = m_cp & (cln64 > 0) & (
+        (is_cdcopy & spec.calldata) | (is_rdcopy & sf.retdata_sym)
+    )
+    # concrete-source copies (code/extcode/concrete returndata): fully
+    # covered words become concrete; partial edge words with stale syms ->
+    # havoc flag
+    conc_src = m_cp & ~is_cdcopy & ~(is_rdcopy & sf.retdata_sym) & (cln64 > 0)
+    W = sf.mem_sym.shape[1]
+    wids = jnp.arange(W)[None, :]
+    full_lo = ((dst64 + 31) // 32)[:, None]
+    full_hi = ((dst64 + cln64) // 32)[:, None]
+    full_cover = (wids >= full_lo) & (wids < full_hi) & conc_src[:, None]
+    mem_sym2 = jnp.where(full_cover, 0, sf.mem_sym)
+    edge_lo = (dst64 // 32)[:, None]
+    edge_hi = ((dst64 + cln64) // 32)[:, None]
+    edge = ((wids == edge_lo) | (wids == edge_hi)) & ~full_cover & conc_src[:, None]
+    edge_dirty = jnp.any(edge & (sf.mem_sym != 0), axis=1)
+    sf = sf.replace(
+        mem_sym=mem_sym2,
+        mem_havoc=sf.mem_havoc | cd_havoc | (conc_src & edge_dirty),
+    )
+
+    # ---- CLS_HALT: capture return-payload syms; SELFDESTRUCT beneficiary ----
+    m_halt = m & (cls == ci.CLS_HALT)
+    has_data = (op == 0xF3) | (op == 0xFD)
+    rv_words = sf.rv_sym.shape[1]
+    cap_ok = m_halt & has_data & aligned & ~sf.mem_havoc
+    rv_sym = sf.rv_sym
+    for k in range(rv_words):
+        in_rv = (jnp.int32(k) * 32) < ln64
+        rv_sym = rv_sym.at[:, k].set(
+            jnp.where(cap_ok & in_rv, _take_word_sym(sf.mem_sym, wm + k), rv_sym[:, k])
+        )
+    is_sd = op == 0xFF
+    sf = sf.replace(
+        rv_sym=rv_sym,
+        sd_to_sym=jnp.where(m_halt & is_sd, s[0], sf.sd_to_sym),
+        sd_to=jnp.where((m_halt & is_sd)[:, None], a[0], sf.sd_to).astype(U32),
+    )
+
+    # ---- write result syms into the result slot (clears stale ids) ----
+    r = jnp.zeros_like(op)
+    r = jnp.where(m_node, r_bin, r)
+    r = jnp.where(m_env, r_env, r)
+    r = jnp.where(m_sha, r_sha, r)
+    r = jnp.where(m_mem & is_load, r_mload, r)
+    m_modhv = m_mod_sym
+    sf2, hv_mod = _havoc(sf, m_modhv)
+    sf = sf2
+    r = jnp.where(m_modhv, hv_mod, r)
+    writes_result = (
+        m_bin | m_mod | m_env | m_sha | (m_mem & is_load)
+    )
+    res_slot = pre_sp - sin
+    sf = sf.replace(
+        stack_sym=_set_sym_slot(sf.stack_sym, res_slot, r, writes_result)
+    )
+    return sf
+
+
+# ---------------------------------------------------------------------------
+# Superstep / forking / run loop
+# ---------------------------------------------------------------------------
+
+
+def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
+                  spec: SymSpec = SymSpec(),
+                  limits: LimitsConfig = DEFAULT_LIMITS) -> SymFrontier:
+    """Advance every running lane by one instruction, symbolically."""
+    f, op, run, old_pc = ci.prologue(sf.base, corpus)
+    sf = sf.replace(base=f)
+    cls = ci._J_CLASS[op]
+    pre_sp = f.sp
+    pre_stack_sym = sf.stack_sym
+    a = [ci._peek(f, i) for i in range(4)]
+    s = [_peek_sym(sf, i) for i in range(7)]
+
+    is_jumpi = op == 0x57
+    known, ksign = _lookup_constraint(sf, s[1])
+    claim_jump = run & (cls == ci.CLS_JUMP) & ((s[0] != 0) | (is_jumpi & (s[1] != 0)))
+    claim_storage = run & (cls == ci.CLS_STORAGE)
+    claim_callish = run & ((cls == ci.CLS_CALL) | (cls == ci.CLS_CREATE))
+    claim_memoff = run & (cls == ci.CLS_MEM) & (s[0] != 0)
+    claim_sha3off = run & (cls == ci.CLS_SHA3) & ((s[0] != 0) | (s[1] != 0))
+    is_ext = op == 0x3C
+    claim_copyoff = run & (cls == ci.CLS_COPY) & (
+        (s[0] != 0) | (s[1] != 0) | (s[2] != 0) | (is_ext & (s[3] != 0))
+    )
+    has_data_halt = (op == 0xF3) | (op == 0xFD)
+    claim_haltoff = run & (cls == ci.CLS_HALT) & has_data_halt & ((s[0] != 0) | (s[1] != 0))
+    claim_logoff = run & (cls == ci.CLS_LOG) & ((s[0] != 0) | (s[1] != 0))
+    claimed = (
+        claim_jump | claim_storage | claim_callish | claim_memoff
+        | claim_sha3off | claim_copyoff | claim_haltoff | claim_logoff
+    )
+
+    f = ci.dispatch(sf.base, env, corpus, op, run, old_pc, skip=claimed)
+    sf = sf.replace(base=f)
+
+    sf = _overlay(sf, env, spec, op, run & ~claimed, cls, pre_sp,
+                  pre_stack_sym, a, s, limits)
+
+    def _cond_apply(sf, mask, fn):
+        return lax.cond(jnp.any(mask), fn, lambda x: x, sf)
+
+    sf = _cond_apply(sf, claim_storage,
+                     lambda x: _h_sym_storage(x, spec, op, claim_storage))
+    sf = _cond_apply(sf, claim_jump,
+                     lambda x: _h_sym_jump(x, corpus, op, claim_jump, old_pc, known, ksign))
+    sf = _cond_apply(sf, claim_callish,
+                     lambda x: _h_sym_callish(x, op, claim_callish, old_pc))
+    misc = claim_memoff | claim_sha3off | claim_copyoff | claim_haltoff | claim_logoff
+    sf = _cond_apply(sf, misc,
+                     lambda x: _h_sym_claimed_misc(x, op, claim_memoff, claim_sha3off,
+                                                   claim_copyoff, claim_haltoff, claim_logoff))
+
+    f = ci.epilogue(sf.base, op, run, old_pc)
+    return sf.replace(base=f)
+
+
+def expand_forks(sf: SymFrontier) -> SymFrontier:
+    """Materialize fork requests: copy each forking lane into a free lane
+    (prefix-sum compaction), point the copy at the jump target, and flip
+    its final path-condition sign to "taken". Forks beyond capacity are
+    counted in ``dropped_forks`` (the frontier equivalent of the
+    reference's unbounded ``work_list.append`` ⚠unv)."""
+    P = sf.n_lanes
+    req = sf.fork_req
+    free = ~sf.base.active
+    n_free = jnp.sum(free.astype(I32))
+    rank = jnp.cumsum(req.astype(I32)) - req.astype(I32)  # exclusive
+    free_ids = jnp.sort(jnp.where(free, jnp.arange(P, dtype=I32), P))
+    slot = jnp.where(req & (rank < n_free), free_ids[jnp.clip(rank, 0, P - 1)], P)
+    src = jnp.arange(P, dtype=I32).at[slot].set(jnp.arange(P, dtype=I32), mode="drop")
+    is_copy = jnp.zeros(P, dtype=bool).at[slot].set(True, mode="drop")
+
+    new = jax.tree.map(lambda x: jnp.take(x, src, axis=0), sf)
+    b = new.base
+    C = new.con_sign.shape[1]
+    last = (jnp.arange(C)[None, :] == (new.con_len - 1)[:, None]) & is_copy[:, None]
+    dropped = new.dropped_forks + (req & (slot == P)).astype(I32)
+    return new.replace(
+        base=b.replace(
+            pc=jnp.where(is_copy, new.fork_dest, b.pc),
+            active=b.active | is_copy,
+        ),
+        con_sign=jnp.where(last, True, new.con_sign),
+        fork_req=jnp.zeros_like(new.fork_req),
+        dropped_forks=dropped,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("spec", "limits", "max_steps", "propagate_every")
+)
+def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
+            spec: SymSpec = SymSpec(),
+            limits: LimitsConfig = DEFAULT_LIMITS,
+            max_steps: int = 256,
+            propagate_every=None) -> SymFrontier:
+    """Run the symbolic engine until quiescence or max_steps supersteps.
+    ``propagate_every`` > 0 interleaves feasibility sweeps that kill
+    provably-unsat lanes (reference: lazy ``Solver.check()`` pruning);
+    0 disables them; None uses ``limits.propagate_every``."""
+    from .propagate import kill_infeasible
+
+    if propagate_every is None:
+        propagate_every = limits.propagate_every
+
+    def cond(state):
+        i, s = state
+        return (i < max_steps) & jnp.any(s.base.running)
+
+    def body(state):
+        i, s = state
+        s = sym_superstep(s, env, corpus, spec, limits)
+        s = expand_forks(s)
+        if propagate_every:
+            s = lax.cond(
+                (i % propagate_every) == propagate_every - 1,
+                kill_infeasible, lambda x: x, s,
+            )
+        return i + 1, s
+
+    _, sf = lax.while_loop(cond, body, (jnp.int32(0), sf))
+    return sf

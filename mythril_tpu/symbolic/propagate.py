@@ -1,0 +1,244 @@
+"""Batched feasibility propagation: unsigned-interval abstract
+interpretation over the per-lane SSA tapes.
+
+This is the on-device replacement for the cheap majority of the
+reference's ``Solver.check()`` calls (``mythril/laser/smt/solver`` ⚠unv,
+SURVEY.md §2.2): one forward pass assigns every tape node an unsigned
+interval [lo, hi] (u256 as 8xu32 limbs); a path constraint
+``(node, sign)`` is contradicted when the interval proves the node can't
+be nonzero (sign=true) or can't be zero (sign=false). Lanes with any
+contradicted constraint are provably infeasible and get killed.
+
+Soundness direction: intervals only ever over-approximate, so a kill is
+always correct; undecided lanes stay alive (the reference keeps unsat
+paths alive until a solver call too). The expensive exact residue goes to
+the host model search (``concretize.py``) only when a detection module
+needs a witness.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import u256
+from .ops import SymOp, FreeKind
+from .state import SymFrontier
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+_MAX = jnp.full(8, 0xFFFFFFFF, dtype=U32)
+
+
+def _full_like(x, top: bool):
+    tgt = _MAX if top else jnp.zeros(8, U32)
+    return jnp.broadcast_to(tgt, x.shape)
+
+
+def _bound_2exp(shape, bits: int):
+    """Inclusive upper bound 2^bits - 1 as limbs."""
+    out = jnp.zeros(shape[:-1] + (8,), dtype=U32)
+    full, rem = bits // 32, bits % 32
+    for limb in range(8):
+        if limb < full:
+            out = out.at[..., limb].set(0xFFFFFFFF)
+        elif limb == full and rem:
+            out = out.at[..., limb].set((1 << rem) - 1)
+    return out
+
+
+def propagate_feasibility(sf: SymFrontier):
+    """Forward pass over every lane's tape.
+
+    Returns ``(lo, hi, infeasible)``: per-node interval arrays
+    ``u32[P, T, 8]`` and the per-lane infeasibility verdict."""
+    P, T = sf.tape_op.shape
+    lo = jnp.zeros((P, T, 8), dtype=U32)
+    hi = jnp.zeros((P, T, 8), dtype=U32)  # node 0 == concrete zero: [0, 0]
+
+    def gather(arr, ids):
+        return jnp.take_along_axis(arr, jnp.clip(ids, 0, T - 1)[:, None, None].astype(I32).repeat(8, 2), axis=1)[:, 0]
+
+    def body(i, carry):
+        lo, hi = carry
+        op = sf.tape_op[:, i]
+        a_id = sf.tape_a[:, i]
+        b_id = sf.tape_b[:, i]
+        imm = sf.tape_imm[:, i]
+        la, ha = gather(lo, a_id), gather(hi, a_id)
+        lb, hb = gather(lo, b_id), gather(hi, b_id)
+
+        top_lo = jnp.zeros_like(la)
+        top_hi = _full_like(ha, True)
+
+        # --- leaves ---
+        r_lo, r_hi = top_lo, top_hi  # default TOP
+        is_const = op == int(SymOp.CONST)
+        r_lo = jnp.where(is_const[:, None], imm, r_lo)
+        r_hi = jnp.where(is_const[:, None], imm, r_hi)
+        is_free = op == int(SymOp.FREE)
+        kind = a_id  # FREE stores kind in a
+        addr_hi = _bound_2exp(ha.shape, 160)
+        small_hi = _bound_2exp(ha.shape, 64)
+        free_hi = top_hi
+        free_hi = jnp.where(
+            ((kind == int(FreeKind.CALLER)) | (kind == int(FreeKind.ORIGIN)))[:, None],
+            addr_hi, free_hi)
+        free_hi = jnp.where(
+            ((kind == int(FreeKind.CALLDATASIZE)) | (kind == int(FreeKind.TIMESTAMP))
+             | (kind == int(FreeKind.NUMBER)))[:, None],
+            small_hi, free_hi)
+        r_lo = jnp.where(is_free[:, None], 0, r_lo)
+        r_hi = jnp.where(is_free[:, None], free_hi, r_hi)
+
+        # --- helpers over operand intervals ---
+        sing_a = jnp.all(la == ha, axis=-1)
+        sing_b = jnp.all(lb == hb, axis=-1)
+        b_can_zero = u256.is_zero(lb)
+        b_all_zero = u256.is_zero(hb)
+
+        # ADD: exact unless the hi sum wraps
+        s_lo, c_lo = u256.add_carry(la, lb)
+        s_hi, c_hi = u256.add_carry(ha, hb)
+        add_exact = ~c_hi
+        r = (jnp.where(add_exact[:, None], s_lo, 0),
+             jnp.where(add_exact[:, None], s_hi, top_hi))
+        r_lo = jnp.where((op == int(SymOp.ADD))[:, None], r[0], r_lo)
+        r_hi = jnp.where((op == int(SymOp.ADD))[:, None], r[1], r_hi)
+
+        # SUB: exact when a surely >= b
+        no_wrap = u256.gte(la, hb)
+        d_lo = u256.sub(la, hb)
+        d_hi = u256.sub(ha, lb)
+        r_lo = jnp.where((op == int(SymOp.SUB))[:, None],
+                         jnp.where(no_wrap[:, None], d_lo, 0), r_lo)
+        r_hi = jnp.where((op == int(SymOp.SUB))[:, None],
+                         jnp.where(no_wrap[:, None], d_hi, top_hi), r_hi)
+
+        # MUL: exact when hi*hi fits 256 bits
+        wide = u256.mul_wide(ha, hb)
+        fits = jnp.all(wide[:, 8:] == 0, axis=-1)
+        m_lo = u256.mul(la, lb)
+        m_hi = wide[:, :8]
+        r_lo = jnp.where((op == int(SymOp.MUL))[:, None],
+                         jnp.where(fits[:, None], m_lo, 0), r_lo)
+        r_hi = jnp.where((op == int(SymOp.MUL))[:, None],
+                         jnp.where(fits[:, None], m_hi, top_hi), r_hi)
+
+        # DIV: b>=1 -> result <= a_hi (no 256-step division here: too slow)
+        r_lo = jnp.where((op == int(SymOp.DIV))[:, None], 0, r_lo)
+        r_hi = jnp.where((op == int(SymOp.DIV))[:, None], ha, r_hi)
+
+        # MOD: < b_hi (and <= a_hi); b identically 0 -> result 0
+        one = jnp.zeros_like(hb).at[:, 0].set(1)
+        b_minus_1 = u256.sub(hb, one)
+        mod_cap = jnp.where(u256.lt(ha, b_minus_1)[:, None], ha, b_minus_1)
+        mod_hi = jnp.where(b_all_zero[:, None], 0, mod_cap)
+        r_lo = jnp.where((op == int(SymOp.MOD))[:, None], 0, r_lo)
+        r_hi = jnp.where((op == int(SymOp.MOD))[:, None], mod_hi, r_hi)
+
+        # AND: <= min(a_hi, b_hi)
+        and_hi = jnp.where(u256.lt(ha, hb)[:, None], ha, hb)
+        r_lo = jnp.where((op == int(SymOp.AND))[:, None], 0, r_lo)
+        r_hi = jnp.where((op == int(SymOp.AND))[:, None], and_hi, r_hi)
+
+        # OR: >= max(a_lo, b_lo)
+        or_lo = jnp.where(u256.gt(la, lb)[:, None], la, lb)
+        r_lo = jnp.where((op == int(SymOp.OR))[:, None], or_lo, r_lo)
+
+        # NOT: exact complement flip
+        r_lo = jnp.where((op == int(SymOp.NOT))[:, None], u256.bit_not(ha), r_lo)
+        r_hi = jnp.where((op == int(SymOp.NOT))[:, None], u256.bit_not(la), r_hi)
+
+        # BYTE: [0, 255]
+        byte_hi = jnp.zeros_like(ha).at[:, 0].set(255)
+        r_lo = jnp.where((op == int(SymOp.BYTE))[:, None], 0, r_lo)
+        r_hi = jnp.where((op == int(SymOp.BYTE))[:, None], byte_hi, r_hi)
+
+        # SHR by singleton shift: exact; else [0, value_hi]
+        shr_exact = sing_a
+        shr_lo = jnp.where(shr_exact[:, None], u256.shr(la, lb), 0)
+        shr_hi = jnp.where(shr_exact[:, None], u256.shr(la, hb), hb)
+        r_lo = jnp.where((op == int(SymOp.SHR))[:, None], shr_lo, r_lo)
+        r_hi = jnp.where((op == int(SymOp.SHR))[:, None], shr_hi, r_hi)
+
+        # SHL by singleton shift: exact when hi<<k doesn't lose bits
+        k_small = sing_a & u256.lt(la, jnp.zeros_like(la).at[:, 0].set(256))
+        shifted_hi = u256.shl(la, hb)
+        back = u256.shr(la, shifted_hi)
+        shl_ok = k_small & u256.eq(back, hb)
+        r_lo = jnp.where((op == int(SymOp.SHL))[:, None],
+                         jnp.where(shl_ok[:, None], u256.shl(la, lb), 0), r_lo)
+        r_hi = jnp.where((op == int(SymOp.SHL))[:, None],
+                         jnp.where(shl_ok[:, None], shifted_hi, top_hi), r_hi)
+
+        # --- boolean producers: result in [0,1], sharpened when decidable ---
+        t_lo = jnp.zeros_like(ha)
+        t_one = jnp.zeros_like(ha).at[:, 0].set(1)
+
+        def bool_iv(surely_true, surely_false):
+            blo = jnp.where(surely_true[:, None], t_one, t_lo)
+            bhi = jnp.where(surely_false[:, None], t_lo, t_one)
+            return blo, bhi
+
+        lt_t = u256.lt(ha, lb)   # a_hi < b_lo -> surely a<b
+        lt_f = u256.gte(la, hb)  # a_lo >= b_hi -> surely not
+        blo, bhi = bool_iv(lt_t, lt_f)
+        r_lo = jnp.where((op == int(SymOp.LT))[:, None], blo, r_lo)
+        r_hi = jnp.where((op == int(SymOp.LT))[:, None], bhi, r_hi)
+
+        gt_t = u256.gt(la, hb)
+        gt_f = u256.lte(ha, lb)
+        blo, bhi = bool_iv(gt_t, gt_f)
+        r_lo = jnp.where((op == int(SymOp.GT))[:, None], blo, r_lo)
+        r_hi = jnp.where((op == int(SymOp.GT))[:, None], bhi, r_hi)
+
+        eq_t = sing_a & sing_b & u256.eq(la, lb)
+        eq_f = u256.lt(ha, lb) | u256.lt(hb, la)  # disjoint intervals
+        blo, bhi = bool_iv(eq_t, eq_f)
+        r_lo = jnp.where((op == int(SymOp.EQ))[:, None], blo, r_lo)
+        r_hi = jnp.where((op == int(SymOp.EQ))[:, None], bhi, r_hi)
+
+        isz_t = u256.is_zero(ha)          # whole interval is {0}
+        isz_f = ~u256.is_zero(la)         # 0 not in interval
+        blo, bhi = bool_iv(isz_t, isz_f)
+        r_lo = jnp.where((op == int(SymOp.ISZERO))[:, None], blo, r_lo)
+        r_hi = jnp.where((op == int(SymOp.ISZERO))[:, None], bhi, r_hi)
+
+        # SLT/SGT undecided: [0, 1]
+        blo, bhi = bool_iv(jnp.zeros_like(lt_t), jnp.zeros_like(lt_t))
+        r_lo = jnp.where(((op == int(SymOp.SLT)) | (op == int(SymOp.SGT)))[:, None], blo, r_lo)
+        r_hi = jnp.where(((op == int(SymOp.SLT)) | (op == int(SymOp.SGT)))[:, None], bhi, r_hi)
+
+        live = (jnp.int32(i) < sf.tape_len) & (op != int(SymOp.NULL))
+        lo = lo.at[:, i].set(jnp.where(live[:, None], r_lo, lo[:, i]))
+        hi = hi.at[:, i].set(jnp.where(live[:, None], r_hi, hi[:, i]))
+        return lo, hi
+
+    lo, hi = lax.fori_loop(1, T, body, (lo, hi))
+
+    # constraint check
+    C = sf.con_node.shape[1]
+    con_live = jnp.arange(C)[None, :] < sf.con_len[:, None]
+    node = jnp.clip(sf.con_node, 0, T - 1)
+    n_lo = jnp.take_along_axis(lo, node[:, :, None].repeat(8, 2), axis=1)
+    n_hi = jnp.take_along_axis(hi, node[:, :, None].repeat(8, 2), axis=1)
+    cant_be_nonzero = jnp.all(n_hi == 0, axis=-1)
+    cant_be_zero = ~jnp.all(n_lo == 0, axis=-1)
+    contradicted = con_live & (sf.con_node != 0) & jnp.where(
+        sf.con_sign, cant_be_nonzero, cant_be_zero
+    )
+    infeasible = jnp.any(contradicted, axis=1)
+    return lo, hi, infeasible
+
+
+def kill_infeasible(sf: SymFrontier) -> SymFrontier:
+    """Deactivate lanes whose path condition is provably unsatisfiable."""
+    _, _, inf = propagate_feasibility(sf)
+    inf = inf & sf.base.active
+    return sf.replace(
+        base=sf.base.replace(active=sf.base.active & ~inf),
+        killed_infeasible=sf.killed_infeasible | inf,
+    )

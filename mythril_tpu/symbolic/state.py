@@ -1,0 +1,164 @@
+"""SymFrontier: the concrete frontier plus the symbolic overlay.
+
+Design: concrete limb arrays stay authoritative for concrete values; a
+parallel "sym id" overlay marks which slots hold symbolic expressions
+(id != 0 → value is tape node, limbs are garbage). This replaces the
+reference's per-object Z3 expressions on stack/memory/storage
+(``mythril/laser/ethereum/state/*.py`` ⚠unv) with two flat arrays per
+storage class.
+
+Granularity choices (documented over-approximations; each introduces
+fresh unconstrained variables rather than wrong values):
+- memory symbolics are tracked per 32-byte word (``mem_sym``);
+- unaligned symbolic stores/loads produce HAVOC leaves;
+- an unaligned CALLDATACOPY (or symbolic-offset store) sets ``mem_havoc``:
+  every later MLOAD of that lane returns a fresh HAVOC leaf.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import LimitsConfig, DEFAULT_LIMITS
+from ..core.frontier import Frontier, make_frontier
+from .ops import SymOp, WELL_KNOWN, N_WELL_KNOWN
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+@dataclass(frozen=True)
+class SymSpec:
+    """Static (trace-time) choice of which inputs are symbolic.
+
+    Mirrors the reference's symbolic tx setup (``execute_message_call``
+    builds symbolic calldata/callvalue/caller ⚠unv, SURVEY.md §2
+    "Transaction models")."""
+
+    calldata: bool = True
+    callvalue: bool = True
+    caller: bool = False       # reference default: concrete ATTACKER address
+    storage: bool = True       # unknown initial storage -> fresh STORAGE leaves
+    block_env: bool = True     # timestamp/number/... symbolic (PredictableVars)
+
+
+@struct.dataclass
+class SymFrontier:
+    base: Frontier
+    # --- overlay: sym ids (0 = concrete) ---
+    stack_sym: jnp.ndarray   # i32[P, S]
+    mem_sym: jnp.ndarray     # i32[P, M/32]
+    mem_havoc: jnp.ndarray   # bool[P] whole-memory havoc (coarse escape hatch)
+    retdata_sym: jnp.ndarray  # bool[P] returndata of last call is symbolic
+    st_val_sym: jnp.ndarray  # i32[P, K]
+    st_key_sym: jnp.ndarray  # i32[P, K] sym id of the key stored in the slot
+    rv_sym: jnp.ndarray      # i32[P, RD/32] sym ids of the RETURN/REVERT payload
+    # --- SSA tape ---
+    tape_op: jnp.ndarray     # i32[P, T]
+    tape_a: jnp.ndarray      # i32[P, T]
+    tape_b: jnp.ndarray      # i32[P, T]
+    tape_imm: jnp.ndarray    # u32[P, T, 8]
+    tape_len: jnp.ndarray    # i32[P]
+    havoc_cnt: jnp.ndarray   # i32[P] fresh-variable counter (HAVOC uniqueness)
+    # --- path condition ---
+    con_node: jnp.ndarray    # i32[P, C]
+    con_sign: jnp.ndarray    # bool[P, C]
+    con_len: jnp.ndarray     # i32[P]
+    killed_infeasible: jnp.ndarray  # bool[P] pruned by constraint propagation
+    # --- fork plumbing (filled by the JUMPI handler, drained by expand_forks) ---
+    fork_req: jnp.ndarray    # bool[P]
+    fork_dest: jnp.ndarray   # i32[P] jump target of the taken branch
+    dropped_forks: jnp.ndarray  # i32[P] forks lost to capacity (reported)
+    # --- detection-facing event records ---
+    sym_jump_dest: jnp.ndarray  # i32[P] node id of a symbolic JUMP dest (SWC-127)
+    n_calls: jnp.ndarray     # i32[P]
+    call_to: jnp.ndarray     # u32[P, CL, 8] concrete callee (if concrete)
+    call_to_sym: jnp.ndarray  # i32[P, CL]
+    call_value: jnp.ndarray  # u32[P, CL, 8]
+    call_value_sym: jnp.ndarray  # i32[P, CL]
+    call_op: jnp.ndarray     # i32[P, CL] raw opcode (CALL/DELEGATECALL/...)
+    call_pc: jnp.ndarray     # i32[P, CL]
+    sd_to_sym: jnp.ndarray   # i32[P] SELFDESTRUCT beneficiary sym id
+    sd_to: jnp.ndarray       # u32[P, 8] concrete beneficiary
+
+    @property
+    def n_lanes(self) -> int:
+        return self.base.pc.shape[0]
+
+    @property
+    def tape_cap(self) -> int:
+        return self.tape_op.shape[1]
+
+
+def make_sym_frontier(
+    n_lanes: int,
+    limits: LimitsConfig = DEFAULT_LIMITS,
+    contract_id=None,
+    gas_limit: int = 10_000_000,
+    active=None,
+    calldata=None,
+    calldata_len=None,
+) -> SymFrontier:
+    """Fresh frontier with the well-known leaves pre-seeded on every tape.
+    Concrete ``calldata`` may be supplied for concolic/concrete replay; the
+    default leaves the buffer zeroed (symbolic reads resolve to leaves)."""
+    P = n_lanes
+    L = limits
+    if calldata_len is None:
+        calldata_len = np.full(P, L.calldata_bytes, dtype=np.int32)
+    base = make_frontier(
+        P, L, contract_id=contract_id, gas_limit=gas_limit, active=active,
+        calldata=calldata, calldata_len=calldata_len,
+    )
+    T, C, K, S = L.tape_len, L.max_constraints, L.storage_slots, L.max_stack
+    CL = L.call_log
+
+    rows = WELL_KNOWN(L.calldata_bytes)
+    n_wk = N_WELL_KNOWN(L.calldata_bytes)
+    assert n_wk <= T, "tape too small for well-known leaves"
+    t_op = np.zeros((P, T), dtype=np.int32)
+    t_a = np.zeros((P, T), dtype=np.int32)
+    t_b = np.zeros((P, T), dtype=np.int32)
+    for i, (op, kind, idx) in enumerate(rows, start=1):
+        t_op[:, i] = op
+        t_a[:, i] = kind
+        t_b[:, i] = idx
+
+    z = lambda *s: jnp.zeros(s, dtype=I32)
+    return SymFrontier(
+        base=base,
+        stack_sym=z(P, S),
+        mem_sym=z(P, L.mem_bytes // 32),
+        mem_havoc=jnp.zeros(P, dtype=bool),
+        retdata_sym=jnp.zeros(P, dtype=bool),
+        st_val_sym=z(P, K),
+        st_key_sym=z(P, K),
+        rv_sym=z(P, L.returndata_bytes // 32),
+        tape_op=jnp.asarray(t_op),
+        tape_a=jnp.asarray(t_a),
+        tape_b=jnp.asarray(t_b),
+        tape_imm=jnp.zeros((P, T, 8), dtype=U32),
+        tape_len=jnp.full(P, n_wk, dtype=I32),
+        havoc_cnt=z(P),
+        con_node=z(P, C),
+        con_sign=jnp.zeros((P, C), dtype=bool),
+        con_len=z(P),
+        killed_infeasible=jnp.zeros(P, dtype=bool),
+        fork_req=jnp.zeros(P, dtype=bool),
+        fork_dest=z(P),
+        dropped_forks=z(P),
+        sym_jump_dest=z(P),
+        n_calls=z(P),
+        call_to=jnp.zeros((P, CL, 8), dtype=U32),
+        call_to_sym=z(P, CL),
+        call_value=jnp.zeros((P, CL, 8), dtype=U32),
+        call_value_sym=z(P, CL),
+        call_op=z(P, CL),
+        call_pc=z(P, CL),
+        sd_to_sym=z(P),
+        sd_to=jnp.zeros((P, 8), dtype=U32),
+    )
