@@ -1,0 +1,267 @@
+"""Witness search: inversion heuristics + randomized repair.
+
+``Solver`` keeps the reference front-door shape
+(``laser/smt/solver/solver.py``: add / check / model ⚠unv) but the
+engine is different: EVM path conditions are overwhelmingly chains of
+(keccak | calldata-window | const) compared through EQ/LT/GT/ISZERO, so a
+directed inversion pass (solve EQ(f(leaf), const) by inverting f) settles
+the dispatcher/require structure, and a bounded randomized repair loop
+mops up the rest. Returns unknown (not unsat) when search fails — same
+degrade-to-no-issue semantics as the reference's solver timeout
+(SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..symbolic.ops import SymOp, FreeKind
+from .eval import Assignment, M256, evaluate
+from .tape import HostTape
+
+
+class UnsatError(Exception):
+    """No witness found (unsat OR search exhausted — like a Z3 timeout)."""
+
+
+_INTERESTING = (0, 1, 2, 0xFF, 1 << 31, 1 << 128, M256, M256 - 1, 1 << 255)
+
+
+def _sat_vector(tape: HostTape, vals: List[int]) -> List[bool]:
+    return [bool(vals[n]) == sign for n, sign in tape.constraints]
+
+
+class _Inverter:
+    """Solve f(leaf) == target for supported op chains."""
+
+    def __init__(self, tape: HostTape, vals: List[int]):
+        self.tape = tape
+        self.vals = vals
+        # SSA order (children precede parents): one linear bottom-up pass
+        # decides free-variable reachability for every node — recursion on
+        # the shared DAG would blow up exponentially
+        hf = [False] * len(tape.nodes)
+        for i, nd in enumerate(tape.nodes):
+            if i == 0 or nd.op == int(SymOp.NULL):
+                continue
+            if nd.op == int(SymOp.FREE):
+                hf[i] = True
+            elif nd.op not in (int(SymOp.CONST),):
+                hf[i] = (nd.a and nd.a < i and hf[nd.a]) or (nd.b and nd.b < i and hf[nd.b])
+        self._has_free = hf
+
+    def has_free(self, i: int) -> bool:
+        return bool(self._has_free[i]) if 0 <= i < len(self._has_free) else False
+
+    def apply(self, i: int, target: int, asn: Assignment) -> bool:
+        """Try to force node i to value `target` by editing `asn`."""
+        target &= M256
+        nd = self.tape.nodes[i]
+        op = nd.op
+        if op == int(SymOp.FREE):
+            return self._set_leaf(i, nd, target, asn)
+        a, b = nd.a, nd.b
+        av, bv = self.vals[a] if a else 0, self.vals[b] if b else 0
+        a_free, b_free = (a and self.has_free(a)), (b and self.has_free(b))
+        if a_free and b_free:
+            return False  # both sides free: out of scope for inversion
+        if op == int(SymOp.ADD):
+            return self.apply(a, target - bv, asn) if a_free else self.apply(b, target - av, asn)
+        if op == int(SymOp.SUB):
+            return self.apply(a, target + bv, asn) if a_free else self.apply(b, av - target, asn)
+        if op == int(SymOp.XOR):
+            return self.apply(a, target ^ bv, asn) if a_free else self.apply(b, target ^ av, asn)
+        if op == int(SymOp.NOT):
+            return self.apply(a, target ^ M256, asn)
+        if op == int(SymOp.MUL):
+            c, x = (bv, a) if a_free else (av, b)
+            if c & 1:  # odd constants are invertible mod 2^256
+                inv = pow(c, -1, 1 << 256)
+                return self.apply(x, (target * inv) & M256, asn)
+            return False
+        if op == int(SymOp.DIV) and a_free:
+            # a // c == target: pick a = target * c (representative)
+            if bv and target * bv <= M256:
+                return self.apply(a, target * bv, asn)
+            return False
+        if op == int(SymOp.SHR) and b_free:
+            # b >> k == target
+            k = av
+            if k < 256 and (target << k) <= M256:
+                return self.apply(b, target << k, asn)
+            return False
+        if op == int(SymOp.SHL) and b_free:
+            k = av
+            if k < 256 and (target & ((1 << k) - 1)) == 0:
+                return self.apply(b, target >> k, asn)
+            return False
+        if op == int(SymOp.AND) and (a_free != b_free):
+            x = a if a_free else b
+            mask = bv if a_free else av
+            if target & ~mask & M256:
+                return False
+            return self.apply(x, target, asn)
+        if op == int(SymOp.ISZERO):
+            if target == 1:
+                return self.apply(a, 0, asn)
+            if target == 0 and a:
+                # need a != 0; try 1 (works for bool-ish and value chains)
+                return self.apply(a, 1, asn)
+            return False
+        if op == int(SymOp.EQ):
+            if target == 1:
+                return self.apply(a, bv, asn) if a_free else self.apply(b, av, asn)
+            if target == 0:
+                x, other = (a, bv) if a_free else (b, av)
+                return self.apply(x, (other + 1) & M256, asn)
+            return False
+        if op in (int(SymOp.LT), int(SymOp.GT)):
+            lt = op == int(SymOp.LT)
+            want_true = target == 1
+            const = bv if a_free else av
+            x = a if a_free else b
+            # strictly-below cases: LT(a<const) wanting true with a free,
+            # or GT(const>b) wanting true with b free; the negations allow
+            # equality, where `const` itself is a valid choice.
+            strictly_below = want_true and (lt == a_free)
+            strictly_above = want_true and (lt != a_free)
+            if strictly_below:
+                if const == 0:
+                    return False
+                return self.apply(x, const - 1, asn)
+            if strictly_above:
+                if const == M256:
+                    return False
+                return self.apply(x, const + 1, asn)
+            return self.apply(x, const, asn)  # non-strict: equality suffices
+        return False
+
+    def _set_leaf(self, node_id: int, nd, target: int, asn: Assignment) -> bool:
+        return _assign_leaf(node_id, nd, target, asn)
+
+
+def _assign_leaf(node_id: int, nd, target: int, asn: Assignment) -> bool:
+    kind = nd.a
+    if kind == int(FreeKind.CALLDATA_WORD):
+        asn.write_calldata_word(nd.b, target)
+        return True
+    if kind == int(FreeKind.CALLER):
+        asn.caller = target
+        return True
+    if kind == int(FreeKind.CALLVALUE):
+        asn.callvalue = target
+        return True
+    if kind == int(FreeKind.CALLDATASIZE):
+        asn.calldatasize = target
+        return True
+    if kind in (int(FreeKind.STORAGE), int(FreeKind.RETVAL), int(FreeKind.HAVOC),
+                int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH)):
+        asn.by_node[node_id] = target
+        return True
+    asn.scalars[(kind, nd.b)] = target
+    return True
+
+
+def _leaf_support(tape: HostTape, root: int) -> List[int]:
+    out, seen, stack = [], set(), [root]
+    while stack:
+        i = stack.pop()
+        if i in seen or i <= 0 or i >= len(tape.nodes):
+            continue
+        seen.add(i)
+        nd = tape.nodes[i]
+        if nd.op == int(SymOp.FREE):
+            out.append(i)
+        else:
+            stack.extend((nd.a, nd.b))
+    return out
+
+
+def _mutate_leaf(tape: HostTape, leaf: int, asn: Assignment, rng: random.Random):
+    nd = tape.nodes[leaf]
+    v = rng.choice(_INTERESTING) if rng.random() < 0.6 else rng.getrandbits(256)
+    _assign_leaf(leaf, nd, v, asn)
+
+
+def solve_tape(tape: HostTape, seed: int = 0, max_iters: int = 400,
+               base: Optional[Assignment] = None) -> Optional[Assignment]:
+    """Find an assignment satisfying every tape constraint, or None."""
+    rng = random.Random(seed)
+    asn = base.copy() if base is not None else Assignment()
+    vals = evaluate(tape, asn)
+
+    # pass 1: directed inversion, weakest constraints first (EQ before
+    # inequalities so dispatcher selectors land before bound nudging)
+    order = sorted(
+        range(len(tape.constraints)),
+        key=lambda j: 0 if tape.nodes[tape.constraints[j][0]].op == int(SymOp.EQ) else 1,
+    )
+    for j in order:
+        node, sign = tape.constraints[j]
+        vals = evaluate(tape, asn)
+        if bool(vals[node]) == sign:
+            continue
+        inv = _Inverter(tape, vals)
+        inv.apply(node, 1 if sign else 0, asn)
+
+    # pass 2: randomized repair (vals always reflects `asn`)
+    vals = evaluate(tape, asn)
+    sat = _sat_vector(tape, vals)
+    if all(sat):
+        return asn
+    inv = _Inverter(tape, vals)
+    for _ in range(max_iters):
+        unsat_idx = [j for j, ok in enumerate(sat) if not ok]
+        if not unsat_idx:
+            return asn
+        j = rng.choice(unsat_idx)
+        node, sign = tape.constraints[j]
+        support = _leaf_support(tape, node)
+        if not support:
+            return None  # constraint over no free vars and unsat: dead
+        cand = asn.copy()
+        if rng.random() < 0.5:
+            inv.vals = vals
+            inv.apply(node, 1 if sign else 0, cand)
+        else:
+            _mutate_leaf(tape, rng.choice(support), cand, rng)
+        cvals = evaluate(tape, cand)
+        csat = _sat_vector(tape, cvals)
+        if sum(csat) >= sum(sat):
+            asn, sat, vals = cand, csat, cvals
+            if all(sat):
+                return asn
+    return None
+
+
+class Solver:
+    """Reference-shaped front door: add constraints, check, get model."""
+
+    def __init__(self, tape: HostTape, seed: int = 0, max_iters: int = 400):
+        self.tape = HostTape(nodes=tape.nodes, constraints=list(tape.constraints))
+        self.seed = seed
+        self.max_iters = max_iters
+        self._model: Optional[Assignment] = None
+
+    def add(self, node: int, sign: bool = True) -> None:
+        self.tape.constraints.append((node, sign))
+
+    def check(self) -> str:
+        self._model = solve_tape(self.tape, self.seed, self.max_iters)
+        return "sat" if self._model is not None else "unknown"
+
+    def model(self) -> Assignment:
+        if self._model is None:
+            raise UnsatError("no model (check() not sat)")
+        return self._model
+
+
+def solve_lane(sf, lane: int, extra_constraints=(), seed: int = 0,
+               max_iters: int = 400) -> Optional[Assignment]:
+    """Witness for lane `lane`'s path condition + extra (node, sign) pairs."""
+    from .tape import extract_tape
+
+    tape = extract_tape(sf, lane, extra_constraints)
+    return solve_tape(tape, seed=seed, max_iters=max_iters)

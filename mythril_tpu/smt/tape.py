@@ -1,0 +1,50 @@
+"""Host-side view of one lane's SSA tape.
+
+Pulls the device arrays for a single lane into plain Python structures so
+the solver can walk them without touching JAX. This is the boundary where
+the reference would hold Z3 ASTs; here an expression IS its tape row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from ..ops import u256
+from ..symbolic.ops import SymOp, FreeKind
+
+
+@dataclass(frozen=True)
+class HostNode:
+    op: int
+    a: int
+    b: int
+    imm: int  # u256 immediate as a Python int
+
+
+@dataclass
+class HostTape:
+    nodes: List[HostNode]           # index = node id; [0] is concrete zero
+    constraints: List[Tuple[int, bool]]  # (node id, asserted sign)
+
+
+def extract_tape(sf, lane: int, extra_constraints=()) -> HostTape:
+    """Materialize lane `lane` of a SymFrontier as a HostTape."""
+    n = int(sf.tape_len[lane])
+    ops = np.asarray(sf.tape_op[lane, :n])
+    a = np.asarray(sf.tape_a[lane, :n])
+    b = np.asarray(sf.tape_b[lane, :n])
+    imm = np.asarray(sf.tape_imm[lane, :n])
+    nodes = [
+        HostNode(int(ops[i]), int(a[i]), int(b[i]), u256.to_int(imm[i]))
+        for i in range(n)
+    ]
+    cn = int(sf.con_len[lane])
+    cons = [
+        (int(sf.con_node[lane, i]), bool(sf.con_sign[lane, i]))
+        for i in range(cn)
+    ]
+    cons.extend(extra_constraints)
+    return HostTape(nodes=nodes, constraints=cons)

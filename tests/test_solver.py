@@ -1,0 +1,117 @@
+"""Witness-search tests: invert path conditions, replay them concretely.
+
+The decisive check mirrors the reference's `get_transaction_sequence`
+usage (⚠unv SURVEY.md §3.3): a model recovered from a symbolic path must,
+when replayed through the CONCRETE engine, reproduce that exact path.
+"""
+
+import numpy as np
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.config import TEST_LIMITS
+from mythril_tpu.core import Corpus, make_env, make_frontier, run
+from mythril_tpu.disassembler import ContractImage
+from mythril_tpu.disassembler.asm import assemble, erc20_like
+from mythril_tpu.smt import Solver, extract_tape, solve_lane
+from mythril_tpu.symbolic import SymSpec, make_sym_frontier, sym_run
+
+
+def explore(code, n_lanes=16, max_steps=192):
+    img = ContractImage.from_bytecode(code, TEST_LIMITS.max_code)
+    corpus = Corpus.from_images([img])
+    active = np.zeros(n_lanes, dtype=bool)
+    active[0] = True
+    sf = make_sym_frontier(n_lanes, TEST_LIMITS, active=active)
+    env = make_env(n_lanes)
+    sf = sym_run(sf, env, corpus, SymSpec(), TEST_LIMITS, max_steps=max_steps)
+    return sf, corpus
+
+
+def replay(code, asn, n=1):
+    """Concrete run with the witness calldata; returns the frontier."""
+    img = ContractImage.from_bytecode(code, TEST_LIMITS.max_code)
+    corpus = Corpus.from_images([img])
+    CD = TEST_LIMITS.calldata_bytes
+    cd = np.zeros((n, CD), dtype=np.uint8)
+    blob = bytes(asn.calldata[:CD])
+    cd[0, : len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+    size = asn.calldatasize if asn.calldatasize is not None else CD
+    f = make_frontier(n, TEST_LIMITS, calldata=cd,
+                      calldata_len=np.full(n, min(size, CD), dtype=np.int32))
+    env = make_env(n)
+    return run(f, env, corpus, max_steps=192)
+
+
+def test_selector_dispatch_witness_replays():
+    # find the transfer-success path of the ERC-20 and recover calldata
+    # that concretely drives it
+    code = erc20_like()
+    sf, _ = explore(code)
+    act = np.asarray(sf.base.active)
+    wrote = np.asarray(sf.base.st_written).any(axis=1)
+    lanes = np.where(act & wrote)[0]
+    assert len(lanes) >= 1
+    lane = int(lanes[0])
+
+    asn = solve_lane(sf, lane)
+    assert asn is not None, "transfer path must be satisfiable"
+    assert bytes(asn.calldata[:4]) == bytes.fromhex("a9059cbb")
+
+    out = replay(code, asn)
+    assert bool(out.halted[0]) and not bool(out.error[0]) and not bool(out.reverted[0])
+    assert bool(np.asarray(out.st_written)[0].any())  # transfer executed
+
+
+def test_lower_bound_constraint_inverted():
+    # require(calldata_arg > 1000): witness must satisfy the bound
+    code = assemble(
+        4, "CALLDATALOAD", ("push2", 1000), "LT",  # 1000 < arg
+        ("ref", "ok"), "JUMPI",
+        0, 0, "REVERT",
+        ("label", "ok"), ("push1", 1), ("push1", 0), "SSTORE", "STOP",
+    )
+    sf, _ = explore(code)
+    act = np.asarray(sf.base.active)
+    wrote = np.asarray(sf.base.st_written).any(axis=1)
+    lane = int(np.where(act & wrote)[0][0])
+    asn = solve_lane(sf, lane)
+    assert asn is not None
+    arg = asn.read_calldata_word(4)
+    assert arg > 1000
+    out = replay(code, asn)
+    assert bool(np.asarray(out.st_written)[0].any())
+
+
+def test_unsat_contradiction_returns_none():
+    # x < 5 and x > 10 via two nested branches — the inner taken lane,
+    # if it existed, would be unsat; emulate by adding the contradicting
+    # extra constraint to the x<5 lane
+    code = assemble(
+        4, "CALLDATALOAD", ("push1", 5), "SWAP1", "LT",  # arg < 5
+        ("ref", "small"), "JUMPI", "STOP",
+        ("label", "small"), ("push1", 1), ("push1", 0), "SSTORE", "STOP",
+    )
+    sf, _ = explore(code)
+    act = np.asarray(sf.base.active)
+    wrote = np.asarray(sf.base.st_written).any(axis=1)
+    lane = int(np.where(act & wrote)[0][0])
+    tape = extract_tape(sf, lane)
+    # find the LT node asserted true on this path, then also assert GT-ish:
+    # reuse the same LT node with opposite sign -> direct contradiction
+    node, sign = tape.constraints[-1]
+    s = Solver(tape, max_iters=50)
+    s.add(node, not sign)
+    assert s.check() == "unknown"
+
+
+def test_solver_front_door_sat_and_model():
+    code = erc20_like()
+    sf, _ = explore(code)
+    act = np.asarray(sf.base.active)
+    wrote = np.asarray(sf.base.st_written).any(axis=1)
+    lane = int(np.where(act & wrote)[0][0])
+    tape = extract_tape(sf, lane)
+    s = Solver(tape)
+    assert s.check() == "sat"
+    m = s.model()
+    assert bytes(m.calldata[:4]) == bytes.fromhex("a9059cbb")
