@@ -1,0 +1,6 @@
+"""The SWC detection-module suite (one module per file, as in the
+reference's ``mythril/analysis/module/modules/`` ⚠unv)."""
+
+from . import integer  # noqa: F401
+
+__all__ = ["integer"]

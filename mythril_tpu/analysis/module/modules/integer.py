@@ -1,0 +1,101 @@
+"""IntegerArithmetics (SWC-101): overflow/underflow detection.
+
+Reference: ``mythril/analysis/module/modules/integer.py`` (⚠unv,
+SURVEY.md §3.3) — on ADD/SUB/MUL the module asserts the no-overflow
+predicate's negation and asks the solver for a model. Here the engine
+recorded every symbolic ADD/SUB/MUL/EXP as (op, a, b, r, pc) node ids;
+the predicate is assembled host-side on the extracted tape:
+
+- ADD overflow  ⇔ (a + b) mod 2^256 < a        -> LT(r, a) == true
+- SUB underflow ⇔ a < b                         -> LT(a, b) == true
+- MUL overflow  ⇔ b != 0 and (a*b mod 2^256)/b != a
+                                                -> ISZERO(b) == false
+                                                   and EQ(DIV(r,b), a) == false
+- EXP is recorded but skipped in v1 (the reference models it via its
+  ExponentFunctionManager; revisit with the exponent concretization).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ....symbolic.ops import SymOp
+from ....smt.tape import HostNode, HostTape
+from ....smt.solver import solve_tape
+from ...report import Issue
+from ..base import DetectionModule, EntryPoint
+from ..loader import register_module
+
+
+@register_module
+class IntegerArithmetics(DetectionModule):
+    name = "IntegerArithmetics"
+    swc_id = "101"
+    description = "Checks for integer over/underflows on ADD/SUB/MUL."
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["ADD", "SUB", "MUL", "EXP"]
+
+    def _execute(self, ctx) -> List[Issue]:
+        issues: List[Issue] = []
+        sf = ctx.sf
+        n_arith = np.asarray(sf.n_arith)
+        arith_op = np.asarray(sf.arith_op)
+        arith_a = np.asarray(sf.arith_a)
+        arith_b = np.asarray(sf.arith_b)
+        arith_r = np.asarray(sf.arith_r)
+        arith_pc = np.asarray(sf.arith_pc)
+        for lane in ctx.lanes():
+            n = int(n_arith[lane])
+            if n == 0:
+                continue
+            for j in range(min(n, arith_op.shape[1])):
+                op = int(arith_op[lane, j])
+                pc = int(arith_pc[lane, j])
+                cid = ctx.contract_of(lane)
+                if self._seen(cid, pc):
+                    continue
+                a = int(arith_a[lane, j])
+                b = int(arith_b[lane, j])
+                r = int(arith_r[lane, j])
+                base = ctx.tape(lane)
+                nodes = list(base.nodes)
+                cons = list(base.constraints)
+                if op == 0x01:  # ADD
+                    nodes.append(HostNode(int(SymOp.LT), r, a, 0))
+                    cons.append((len(nodes) - 1, True))
+                    word = "overflow"
+                elif op == 0x03:  # SUB
+                    nodes.append(HostNode(int(SymOp.LT), a, b, 0))
+                    cons.append((len(nodes) - 1, True))
+                    word = "underflow"
+                elif op == 0x02:  # MUL
+                    nodes.append(HostNode(int(SymOp.ISZERO), b, 0, 0))
+                    cons.append((len(nodes) - 1, False))
+                    nodes.append(HostNode(int(SymOp.DIV), r, b, 0))
+                    nodes.append(HostNode(int(SymOp.EQ), len(nodes) - 1, a, 0))
+                    cons.append((len(nodes) - 1, False))
+                    word = "overflow"
+                else:
+                    continue  # EXP: v1 skip
+                asn = solve_tape(HostTape(nodes=nodes, constraints=cons),
+                                 max_iters=ctx.solver_iters)
+                if asn is None:
+                    self._cache.discard((cid, pc))  # other lanes may decide it
+                    continue
+                issues.append(Issue(
+                    swc_id=self.swc_id,
+                    title="Integer Arithmetic Bugs",
+                    severity="High",
+                    address=pc,
+                    contract=ctx.contract_name(lane),
+                    lane=int(lane),
+                    description=(
+                        "The arithmetic operation can result in integer "
+                        f"{word}. The operands are attacker-controlled and "
+                        "the wrapped result flows onward unchecked."
+                    ),
+                    transaction_sequence=ctx.tx_sequence(asn),
+                ))
+        return issues

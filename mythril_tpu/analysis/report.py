@@ -1,0 +1,109 @@
+"""Issue + Report: the user-visible output of an analysis run.
+
+Mirrors the reference's ``mythril/analysis/report.py`` (⚠unv): an
+``Issue`` carries SWC id, severity, locations, and a concrete
+transaction witness; ``Report`` renders text / markdown / json with the
+same top-level shape so downstream tooling can switch over.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+SWC_TITLES = {
+    "101": "Integer Overflow and Underflow",
+    "104": "Unchecked Call Return Value",
+    "105": "Unprotected Ether Withdrawal",
+    "106": "Unprotected SELFDESTRUCT Instruction",
+    "107": "Reentrancy",
+    "110": "Assert Violation",
+    "111": "Use of Deprecated Solidity Functions",
+    "112": "Delegatecall to Untrusted Callee",
+    "113": "DoS with Failed Call",
+    "114": "Transaction Order Dependence",
+    "115": "Authorization through tx.origin",
+    "116": "Block values as a proxy for time",
+    "120": "Weak Sources of Randomness from Chain Attributes",
+    "124": "Write to Arbitrary Storage Location",
+    "127": "Arbitrary Jump with Function Type Variable",
+}
+
+
+@dataclass
+class Issue:
+    swc_id: str
+    title: str
+    severity: str              # High / Medium / Low
+    address: int               # bytecode offset (pc)
+    description: str
+    contract: str = ""
+    function: str = ""
+    lane: int = -1             # frontier lane that witnessed the issue
+    transaction_sequence: Optional[List[Dict]] = None
+
+    def as_dict(self) -> Dict:
+        return {
+            "swc-id": self.swc_id,
+            "swcTitle": SWC_TITLES.get(self.swc_id, ""),
+            "title": self.title,
+            "severity": self.severity,
+            "address": self.address,
+            "contract": self.contract,
+            "function": self.function,
+            "description": self.description,
+            "tx_sequence": self.transaction_sequence,
+        }
+
+
+@dataclass
+class Report:
+    issues: List[Issue] = field(default_factory=list)
+    contract_name: str = ""
+
+    def append(self, issue: Issue) -> None:
+        self.issues.append(issue)
+
+    def sorted(self) -> List[Issue]:
+        return sorted(self.issues, key=lambda i: (i.address, i.swc_id))
+
+    def as_text(self) -> str:
+        if not self.issues:
+            return "The analysis was completed successfully. No issues were detected.\n"
+        out = []
+        for i in self.sorted():
+            out.append(f"==== {i.title} ====")
+            out.append(f"SWC ID: {i.swc_id}")
+            out.append(f"Severity: {i.severity}")
+            out.append(f"Contract: {i.contract or 'Unknown'}")
+            out.append(f"PC address: {i.address}")
+            out.append(i.description.strip())
+            if i.transaction_sequence:
+                out.append("Transaction Sequence:")
+                for tx in i.transaction_sequence:
+                    out.append("  " + json.dumps(tx, sort_keys=True))
+            out.append("")
+        return "\n".join(out)
+
+    def as_markdown(self) -> str:
+        if not self.issues:
+            return "# Analysis results\n\nNo issues found.\n"
+        out = ["# Analysis results\n"]
+        for i in self.sorted():
+            out.append(f"## {i.title}")
+            out.append(f"- SWC ID: {i.swc_id}")
+            out.append(f"- Severity: {i.severity}")
+            out.append(f"- PC address: {i.address}\n")
+            out.append(i.description.strip() + "\n")
+        return "\n".join(out)
+
+    def as_json(self) -> str:
+        return json.dumps(
+            {
+                "success": True,
+                "error": None,
+                "issues": [i.as_dict() for i in self.sorted()],
+            },
+            sort_keys=True,
+        )

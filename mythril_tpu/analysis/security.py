@@ -1,0 +1,30 @@
+"""fire_lasers: run every registered detection module over a finished
+exploration and collect the report.
+
+Reference: ``mythril/analysis/security.py`` (⚠unv) — POST modules run
+over the final statespace, CALLBACK modules are drained; per-module
+exceptions are caught so one module can't kill the run (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from .module.loader import ModuleLoader
+from .report import Issue, Report
+
+log = logging.getLogger(__name__)
+
+
+def fire_lasers(ctx, white_list: Optional[List[str]] = None) -> Report:
+    report = Report()
+    loader = ModuleLoader()
+    loader.reset_modules()
+    for module in loader.get_detection_modules(white_list):
+        try:
+            for issue in module.execute(ctx):
+                report.append(issue)
+        except Exception:  # noqa: BLE001 — degrade like the reference
+            log.exception("detection module %s failed", module.name)
+    return report

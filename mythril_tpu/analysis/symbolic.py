@@ -1,0 +1,120 @@
+"""SymExecWrapper + AnalysisContext: wire the engine to the modules.
+
+Reference: ``mythril/analysis/symbolic.py`` (⚠unv) — ``SymExecWrapper``
+builds the LASER VM with strategy/plugins/modules and runs it. Here it
+builds the corpus + frontier, runs ``sym_run`` (one jitted call — the
+whole exploration), and exposes an :class:`AnalysisContext` that modules
+consume batched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import DEFAULT_LIMITS, LimitsConfig
+from ..core import Corpus, make_env
+from ..disassembler import ContractImage
+from ..smt.eval import Assignment
+from ..smt.solver import solve_tape
+from ..smt.tape import HostTape, extract_tape
+from ..symbolic import SymSpec, make_sym_frontier, sym_run
+
+
+@dataclass
+class AnalysisContext:
+    """Batched view of one finished exploration, handed to modules."""
+
+    sf: object               # final SymFrontier
+    corpus: Corpus
+    limits: LimitsConfig
+    contract_names: List[str]
+    solver_iters: int = 400
+    _tapes: Dict[int, HostTape] = field(default_factory=dict)
+
+    def lanes(self, include_errors: bool = False,
+              include_reverted: bool = False) -> np.ndarray:
+        """Lane indices that hold surviving paths. Exceptional halts are
+        discarded like the reference's VmException states; reverted paths
+        are excluded by default — a reverting transaction has no effect,
+        so predicates witnessed only on a revert path (e.g. the guard
+        branch of a SafeMath add) are not findings. The Exceptions module
+        opts into error lanes explicitly."""
+        act = np.asarray(self.sf.base.active)
+        err = np.asarray(self.sf.base.error)
+        rev = np.asarray(self.sf.base.reverted)
+        keep = act.copy()
+        if not include_errors:
+            keep &= ~err
+        if not include_reverted:
+            keep &= ~rev
+        return np.where(keep)[0]
+
+    def tape(self, lane: int) -> HostTape:
+        if lane not in self._tapes:
+            self._tapes[lane] = extract_tape(self.sf, lane)
+        return self._tapes[lane]
+
+    def solve(self, lane: int, extra_constraints=()) -> Optional[Assignment]:
+        """Witness for the lane's path condition + extra (node, sign)."""
+        base = self.tape(lane)
+        t = HostTape(nodes=list(base.nodes),
+                     constraints=list(base.constraints) + list(extra_constraints))
+        return solve_tape(t, max_iters=self.solver_iters)
+
+    def contract_of(self, lane: int) -> int:
+        return int(np.asarray(self.sf.base.contract_id[lane]))
+
+    def contract_name(self, lane: int) -> str:
+        cid = self.contract_of(lane)
+        return self.contract_names[cid] if cid < len(self.contract_names) else f"contract_{cid}"
+
+    def tx_sequence(self, asn: Assignment) -> List[dict]:
+        """Render a witness as the reference-style concrete tx list.
+        All `calldatasize` bytes are emitted — trimming zeros would change
+        CALLDATASIZE on replay and can flip size-check branches."""
+        size = asn.calldatasize if asn.calldatasize is not None else len(asn.calldata)
+        size = max(0, min(size, len(asn.calldata)))
+        data = bytes(asn.calldata[:size])
+        return [{
+            "input": "0x" + data.hex(),
+            "value": hex(asn.callvalue),
+            "origin": hex(asn.caller),
+            "caller": hex(asn.caller),
+        }]
+
+
+class SymExecWrapper:
+    """Build + run the symbolic exploration for a batch of contracts."""
+
+    def __init__(
+        self,
+        bytecodes: Sequence[bytes],
+        contract_names: Optional[Sequence[str]] = None,
+        limits: LimitsConfig = DEFAULT_LIMITS,
+        spec: SymSpec = SymSpec(),
+        lanes_per_contract: int = 64,
+        max_steps: int = 512,
+        solver_iters: int = 400,
+    ):
+        self.limits = limits
+        self.spec = spec
+        images = [ContractImage.from_bytecode(c, limits.max_code) for c in bytecodes]
+        self.corpus = Corpus.from_images(images)
+        C = len(images)
+        P = C * lanes_per_contract
+        contract_id = np.repeat(np.arange(C, dtype=np.int32), lanes_per_contract)
+        active = np.zeros(P, dtype=bool)
+        active[::lanes_per_contract] = True  # one seed lane per contract
+        sf = make_sym_frontier(P, limits, contract_id=contract_id, active=active)
+        env = make_env(P)
+        self.sf = sym_run(sf, env, self.corpus, spec, limits, max_steps=max_steps)
+        self.ctx = AnalysisContext(
+            sf=self.sf,
+            corpus=self.corpus,
+            limits=limits,
+            contract_names=list(contract_names or [f"contract_{i}" for i in range(C)]),
+            solver_iters=solver_iters,
+        )

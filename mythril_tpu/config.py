@@ -28,6 +28,7 @@ class LimitsConfig:
     max_constraints: int = 64  # path-condition slots per lane
     call_depth: int = 4  # saved call contexts per lane
     call_log: int = 8  # recorded external-call events per lane
+    arith_log: int = 16  # recorded symbolic-arithmetic events per lane
     propagate_every: int = 8  # supersteps between feasibility sweeps
 
     def __post_init__(self):
@@ -51,5 +52,6 @@ TEST_LIMITS = LimitsConfig(
     max_constraints=32,
     call_depth=2,
     call_log=4,
+    arith_log=8,
     propagate_every=4,
 )

@@ -132,6 +132,15 @@ def _havoc(sf: SymFrontier, mask):
     return sf2.replace(havoc_cnt=sf2.havoc_cnt + mask.astype(I32)), ids
 
 
+def _event_slot(counter, mask, length: int):
+    """Bounded per-lane event-log allocation: onehot[P, L] of the next
+    free slot where `mask`; saturated logs silently drop (counter still
+    counts attempts so overflow is observable)."""
+    idx = jnp.minimum(counter, length - 1)
+    rec = mask & (counter < length)
+    return (jnp.arange(length)[None, :] == idx[:, None]) & rec[:, None]
+
+
 def _lookup_constraint(sf: SymFrontier, node):
     """Is `node` already asserted on the path? -> (known, sign)."""
     C = sf.con_node.shape[1]
@@ -315,9 +324,7 @@ def _h_sym_callish(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
     havoc_mem = m & ~is_create & ((out_len_sym != 0) | ~u256.is_zero(out_len))
 
     CL = sf.call_to.shape[1]
-    idx = jnp.minimum(sf.n_calls, CL - 1)
-    rec = m & (sf.n_calls < CL)
-    onehot = (jnp.arange(CL)[None, :] == idx[:, None]) & rec[:, None]
+    onehot = _event_slot(sf.n_calls, m, CL)
 
     sf, rv = append_node(sf, m, int(SymOp.FREE), int(FreeKind.RETVAL), sf.n_calls)
     f = sf.base
@@ -438,6 +445,23 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     sf, bid = _sym_or_const(sf, m_node & ~is_unary, s[1], a[1])
     bid = jnp.where(is_unary, 0, bid)  # unary nodes must not carry stale b
     sf, r_bin = append_node(sf, m_node, node_op, aid, bid)
+
+    # record symbolic ADD/SUB/MUL/EXP events for the IntegerArithmetics
+    # module (reference: overflow predicates built inline in the module's
+    # pre-hook on these opcodes ⚠unv SURVEY.md §3.3; here the predicate is
+    # assembled host-side from the recorded operand node ids)
+    is_arith = (op == 0x01) | (op == 0x02) | (op == 0x03) | (op == 0x0A)
+    m_ar = m_node & is_arith
+    ar_onehot = _event_slot(sf.n_arith, m_ar, sf.arith_op.shape[1])
+    old_pc_arr = sf.base.pc  # prologue left pc at the instruction
+    sf = sf.replace(
+        n_arith=sf.n_arith + m_ar.astype(I32),
+        arith_op=jnp.where(ar_onehot, op[:, None], sf.arith_op),
+        arith_a=jnp.where(ar_onehot, aid[:, None], sf.arith_a),
+        arith_b=jnp.where(ar_onehot, bid[:, None], sf.arith_b),
+        arith_r=jnp.where(ar_onehot, r_bin[:, None], sf.arith_r),
+        arith_pc=jnp.where(ar_onehot, old_pc_arr[:, None], sf.arith_pc),
+    )
 
     # ---- CLS_MODARITH: symbolic addmod/mulmod -> havoc (documented) ----
     m_mod = m & (cls == ci.CLS_MODARITH)

@@ -84,6 +84,13 @@ class SymFrontier:
     call_pc: jnp.ndarray     # i32[P, CL]
     sd_to_sym: jnp.ndarray   # i32[P] SELFDESTRUCT beneficiary sym id
     sd_to: jnp.ndarray       # u32[P, 8] concrete beneficiary
+    # symbolic-arithmetic events (IntegerArithmetics SWC-101 feed)
+    n_arith: jnp.ndarray     # i32[P]
+    arith_op: jnp.ndarray    # i32[P, AL] EVM opcode (ADD/SUB/MUL/EXP)
+    arith_a: jnp.ndarray     # i32[P, AL] operand node ids (post sym_or_const)
+    arith_b: jnp.ndarray     # i32[P, AL]
+    arith_r: jnp.ndarray     # i32[P, AL] result node id
+    arith_pc: jnp.ndarray    # i32[P, AL]
 
     @property
     def n_lanes(self) -> int:
@@ -161,4 +168,10 @@ def make_sym_frontier(
         call_pc=z(P, CL),
         sd_to_sym=z(P),
         sd_to=jnp.zeros((P, 8), dtype=U32),
+        n_arith=z(P),
+        arith_op=z(P, L.arith_log),
+        arith_a=z(P, L.arith_log),
+        arith_b=z(P, L.arith_log),
+        arith_r=z(P, L.arith_log),
+        arith_pc=z(P, L.arith_log),
     )

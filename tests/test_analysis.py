@@ -1,0 +1,105 @@
+"""End-to-end detection MVP: SymExecWrapper -> fire_lasers -> Report.
+
+The reference's golden-file style (known-vulnerable fixture in, expected
+issues out — SURVEY.md §4) with hand-assembled fixtures instead of solc
+output.
+"""
+
+import json
+
+import numpy as np
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.config import TEST_LIMITS
+from mythril_tpu.disassembler.asm import assemble, erc20_like
+from mythril_tpu.analysis import SymExecWrapper, fire_lasers
+
+
+def analyze(code, white_list=None, **kw):
+    kw.setdefault("limits", TEST_LIMITS)
+    kw.setdefault("lanes_per_contract", 16)
+    kw.setdefault("max_steps", 192)
+    sym = SymExecWrapper([code], **kw)
+    return fire_lasers(sym.ctx, white_list)
+
+
+def unsafe_counter() -> bytes:
+    """add(uint256): storage[0] += arg, no overflow check (SWC-101)."""
+    return assemble(
+        4, "CALLDATALOAD",     # arg
+        0, "SLOAD",            # counter
+        "ADD",
+        0, "SSTORE",
+        "STOP",
+    )
+
+
+def safe_concrete() -> bytes:
+    """Arithmetic over constants only: nothing symbolic, no findings."""
+    return assemble(
+        ("push1", 40), ("push1", 2), "ADD",
+        ("push1", 0), "SSTORE",
+        "STOP",
+    )
+
+
+def test_integer_overflow_found_with_witness():
+    report = analyze(unsafe_counter())
+    issues = [i for i in report.issues if i.swc_id == "101"]
+    assert issues, "unchecked ADD must be flagged"
+    issue = issues[0]
+    assert issue.severity == "High"
+    assert issue.transaction_sequence, "witness tx required"
+    tx = issue.transaction_sequence[0]
+    assert tx["input"].startswith("0x")
+
+
+def test_concrete_arithmetic_not_flagged():
+    report = analyze(safe_concrete())
+    assert not report.issues
+
+
+def test_erc20_transfer_add_flagged_sub_guarded():
+    # the hand-written token: SUB is guarded by the balance check, the
+    # receiver-side ADD can overflow (matches upstream mythril's verdict
+    # on unchecked-add solidity <0.8 tokens)
+    report = analyze(erc20_like())
+    pcs = {i.address for i in report.issues if i.swc_id == "101"}
+    assert pcs, "receiver-side ADD should be satisfiable-overflow"
+
+
+def safe_checked_add() -> bytes:
+    """SafeMath pattern: r = a + b; if (r < a) revert — the overflow is
+    only witnessable on the reverting branch, so it must NOT be flagged."""
+    return assemble(
+        4, "CALLDATALOAD",       # a (attacker controlled)
+        0, "SLOAD", "DUP2",      # [a, counter, a]
+        "ADD",                   # r = counter + a      [a, r]
+        "DUP1", "DUP3", "GT",    # a > r ?              [a, r, ovf]
+        ("ref", "oops"), "JUMPI",
+        0, "SSTORE", "POP", "STOP",
+        ("label", "oops"), 0, 0, "REVERT",
+    )
+
+
+def test_checked_add_not_flagged():
+    report = analyze(safe_checked_add())
+    assert not [i for i in report.issues if i.swc_id == "101"], (
+        "overflow witnessed only on the revert branch is not a finding"
+    )
+
+
+def test_report_renderers():
+    report = analyze(unsafe_counter())
+    text = report.as_text()
+    assert "SWC ID: 101" in text
+    md = report.as_markdown()
+    assert "Integer" in md
+    payload = json.loads(report.as_json())
+    assert payload["success"] is True
+    assert payload["issues"][0]["swc-id"] == "101"
+
+
+def test_module_whitelist_filters():
+    report = analyze(unsafe_counter(), white_list=["nonexistent-module"])
+    assert not report.issues
