@@ -1,0 +1,74 @@
+"""RequirementsViolation (SWC-123): a call into another contract violates
+that callee's requirements (Error(string) revert in a sub-frame).
+
+Reference: ``mythril/analysis/module/modules/requirements_violation.py``
+(⚠unv). This module needs sub-transaction frames to observe a CALLEE's
+revert; until the inter-contract call layer lands (BASELINE config 4),
+external calls are summarized by symbolic RETVALs and no sub-frame revert
+payloads exist — the scan below activates automatically once the tx layer
+records callee frames with Error(string) payloads.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...report import Issue
+from ..base import DetectionModule, EntryPoint
+from ..loader import register_module
+
+ERROR_SELECTOR = bytes.fromhex("08c379a0")
+
+
+@register_module
+class RequirementsViolation(DetectionModule):
+    name = "RequirementsViolation"
+    swc_id = "123"
+    description = "A requirement of a called contract is violated."
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["REVERT"]
+
+    def _execute(self, ctx) -> List[Issue]:
+        issues: List[Issue] = []
+        # sub-call frames: recorded by the transaction layer as lanes whose
+        # tx depth > 0; absent that metadata, there is nothing to scan
+        depth = getattr(ctx.sf, "tx_depth", None)
+        if depth is None:
+            return issues
+        reverted = np.asarray(ctx.sf.base.reverted)
+        retval = np.asarray(ctx.sf.base.retval)
+        retval_len = np.asarray(ctx.sf.base.retval_len)
+        pcs = np.asarray(ctx.sf.base.pc)
+        depth = np.asarray(depth)
+        for lane in ctx.lanes(include_reverted=True):
+            if int(depth[lane]) == 0 or not bool(reverted[lane]):
+                continue
+            if int(retval_len[lane]) < 4:
+                continue
+            payload = bytes(retval[lane, :4])
+            if payload != ERROR_SELECTOR:
+                continue
+            pc = int(pcs[lane])
+            cid = ctx.contract_of(lane)
+            if self._seen(cid, pc):
+                continue
+            asn = ctx.solve(lane)
+            if asn is None:
+                self._cache.discard((cid, pc))
+                continue
+            issues.append(Issue(
+                swc_id=self.swc_id,
+                title="Requirement violation in a called contract",
+                severity="Medium",
+                address=pc,
+                contract=ctx.contract_name(lane),
+                lane=int(lane),
+                description=(
+                    "A require() of a called contract can be violated by "
+                    "this caller's inputs."
+                ),
+                transaction_sequence=ctx.tx_sequence(asn),
+            ))
+        return issues

@@ -57,10 +57,14 @@ class AnalysisContext:
             self._tapes[lane] = extract_tape(self.sf, lane)
         return self._tapes[lane]
 
-    def solve(self, lane: int, extra_constraints=()) -> Optional[Assignment]:
-        """Witness for the lane's path condition + extra (node, sign)."""
+    def solve(self, lane: int, extra_constraints=(),
+              extra_nodes=()) -> Optional[Assignment]:
+        """Witness for the lane's path condition + extra (node, sign)
+        constraints. ``extra_nodes`` are appended to the tape first (ids
+        continue after the lane's last node) so modules can constrain
+        derived predicates without touching the device tape."""
         base = self.tape(lane)
-        t = HostTape(nodes=list(base.nodes),
+        t = HostTape(nodes=list(base.nodes) + list(extra_nodes),
                      constraints=list(base.constraints) + list(extra_constraints))
         return solve_tape(t, max_iters=self.solver_iters)
 
@@ -75,13 +79,16 @@ class AnalysisContext:
         """Render a witness as the reference-style concrete tx list.
         All `calldatasize` bytes are emitted — trimming zeros would change
         CALLDATASIZE on replay and can flip size-check branches."""
+        from ..symbolic.ops import FreeKind
+
         size = asn.calldatasize if asn.calldatasize is not None else len(asn.calldata)
         size = max(0, min(size, len(asn.calldata)))
         data = bytes(asn.calldata[:size])
+        origin = asn.scalars.get((int(FreeKind.ORIGIN), 0), asn.caller)
         return [{
             "input": "0x" + data.hex(),
             "value": hex(asn.callvalue),
-            "origin": hex(asn.caller),
+            "origin": hex(origin),
             "caller": hex(asn.caller),
         }]
 

@@ -7,7 +7,7 @@ the reference would hold Z3 ASTs; here an expression IS its tape row.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List, Tuple
 
 import numpy as np
@@ -28,6 +28,64 @@ class HostNode:
 class HostTape:
     nodes: List[HostNode]           # index = node id; [0] is concrete zero
     constraints: List[Tuple[int, bool]]  # (node id, asserted sign)
+    pcs: List[int] = field(default_factory=list)  # branch pc per constraint (may be shorter)
+
+
+def support(tape: HostTape, root: int):
+    """(leaf node ids, FreeKind set) reachable from `root` (iterative)."""
+    ids, kinds, seen, stack = [], set(), set(), [root]
+    while stack:
+        i = stack.pop()
+        if i in seen or i <= 0 or i >= len(tape.nodes):
+            continue
+        seen.add(i)
+        nd = tape.nodes[i]
+        if nd.op == int(SymOp.FREE):
+            ids.append(i)
+            kinds.add(nd.a)
+        elif nd.op not in (int(SymOp.CONST), int(SymOp.NULL)):
+            stack.extend((nd.a, nd.b))
+    return ids, kinds
+
+
+def constraint_support(tape: HostTape):
+    """Union of leaf supports over every path constraint."""
+    ids, kinds = set(), set()
+    for node, _ in tape.constraints:
+        i, k = support(tape, node)
+        ids.update(i)
+        kinds.update(k)
+    return ids, kinds
+
+
+ATTACKER_KINDS = {
+    int(FreeKind.CALLDATA_WORD), int(FreeKind.CALLDATASIZE),
+    int(FreeKind.CALLVALUE), int(FreeKind.CALLER),
+}
+
+
+def attacker_controlled(tape: HostTape, root: int) -> bool:
+    """Does `root` depend on tx inputs the attacker chooses?"""
+    _, kinds = support(tape, root)
+    return bool(kinds & ATTACKER_KINDS)
+
+
+def keccak_derived(tape: HostTape, root: int) -> bool:
+    """Does `root`'s value flow through a KECCAK digest? (A storage key
+    that is a hash of something is solidity mapping access, not an
+    arbitrary-write primitive.)"""
+    seen, stack = set(), [root]
+    while stack:
+        i = stack.pop()
+        if i in seen or i <= 0 or i >= len(tape.nodes):
+            continue
+        seen.add(i)
+        nd = tape.nodes[i]
+        if nd.op == int(SymOp.KECCAK):
+            return True
+        if nd.op not in (int(SymOp.CONST), int(SymOp.NULL), int(SymOp.FREE)):
+            stack.extend((nd.a, nd.b))
+    return False
 
 
 def extract_tape(sf, lane: int, extra_constraints=()) -> HostTape:
@@ -46,5 +104,6 @@ def extract_tape(sf, lane: int, extra_constraints=()) -> HostTape:
         (int(sf.con_node[lane, i]), bool(sf.con_sign[lane, i]))
         for i in range(cn)
     ]
+    pcs = [int(sf.con_pc[lane, i]) for i in range(cn)]
     cons.extend(extra_constraints)
-    return HostTape(nodes=nodes, constraints=cons)
+    return HostTape(nodes=nodes, constraints=cons, pcs=pcs)

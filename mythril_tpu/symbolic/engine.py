@@ -152,7 +152,7 @@ def _lookup_constraint(sf: SymFrontier, node):
     return known, known & sign
 
 
-def _append_constraint(sf: SymFrontier, mask, node, sign):
+def _append_constraint(sf: SymFrontier, mask, node, sign, pc):
     C = sf.con_node.shape[1]
     overflow = mask & (sf.con_len >= C)
     write = mask & ~overflow
@@ -161,6 +161,7 @@ def _append_constraint(sf: SymFrontier, mask, node, sign):
     return sf.replace(
         con_node=jnp.where(onehot, node[:, None], sf.con_node),
         con_sign=jnp.where(onehot, sign[:, None], sf.con_sign),
+        con_pc=jnp.where(onehot, pc[:, None], sf.con_pc),
         con_len=sf.con_len + write.astype(I32),
         base=sf.base.replace(error=sf.base.error | overflow),
     )
@@ -217,6 +218,19 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     # concrete handler)
     slot_id = jnp.argmax(match, axis=1).astype(I32)
     onehot, overflow = ci.storage_alloc(f, hit, slot_id, m & is_store)
+    # SWC event records: first SSTORE after a RE-ENTERABLE external call
+    # (STATICCALL/CREATE can't re-enter mutably), and first SSTORE through
+    # a symbolic NON-keccak key (a direct-keccak key is a mapping access;
+    # recording it would mask a later genuine arbitrary write, since only
+    # the first event is kept)
+    store_m = m & is_store
+    first_after_call = store_m & (sf.n_mut_calls > 0) & (sf.sstore_after_call_pc < 0)
+    T = sf.tape_op.shape[1]
+    key_op = jnp.take_along_axis(
+        sf.tape_op, jnp.clip(key_sym, 0, T - 1)[:, None], axis=1
+    )[:, 0]
+    key_is_hash = key_op == int(SymOp.KECCAK)
+    first_arb = store_m & (key_sym != 0) & ~key_is_hash & (sf.arb_key_pc < 0)
     return sf.replace(
         base=f.replace(
             stack=stack,
@@ -230,6 +244,9 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
         stack_sym=stack_sym,
         st_key_sym=jnp.where(onehot, key_sym[:, None], sf.st_key_sym),
         st_val_sym=jnp.where(onehot, val_sym[:, None], sf.st_val_sym),
+        sstore_after_call_pc=jnp.where(first_after_call, f.pc, sf.sstore_after_call_pc),
+        arb_key_node=jnp.where(first_arb, key_sym, sf.arb_key_node),
+        arb_key_pc=jnp.where(first_arb, f.pc, sf.arb_key_pc),
     )
 
 
@@ -278,7 +295,7 @@ def _h_sym_jump(sf: SymFrontier, corpus: Corpus, op, m, old_pc, known, ksign) ->
     # corrupted path condition.
     con_ok = sf.con_len < sf.con_node.shape[1]
     fork_ok = m_fork & valid_dest & con_ok
-    sf = _append_constraint(sf, m_fork, cond_sym, False)
+    sf = _append_constraint(sf, m_fork, cond_sym, False, old_pc)
 
     f = sf.base
     new_pc = jnp.where(m_res & conc_taken, dest.astype(I32), old_pc + 1)
@@ -292,6 +309,7 @@ def _h_sym_jump(sf: SymFrontier, corpus: Corpus, op, m, old_pc, known, ksign) ->
             halted=f.halted | sym_taken,
         ),
         sym_jump_dest=jnp.where(sym_taken | sym_unres, dest_sym, sf.sym_jump_dest),
+        sym_jump_pc=jnp.where(sym_taken | sym_unres, old_pc, sf.sym_jump_pc),
         fork_req=sf.fork_req | fork_ok,
         fork_dest=jnp.where(fork_ok, dest.astype(I32), sf.fork_dest),
     )
@@ -340,6 +358,9 @@ def _h_sym_callish(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
         mem_havoc=sf.mem_havoc | havoc_mem,
         retdata_sym=sf.retdata_sym | (m & ~is_create),
         n_calls=sf.n_calls + m.astype(I32),
+        n_mut_calls=sf.n_mut_calls + (
+            m & ((op == 0xF1) | (op == 0xF2) | (op == 0xF4))
+        ).astype(I32),
         call_to=jnp.where(onehot[:, :, None], to_rec[:, None, :], sf.call_to),
         call_to_sym=jnp.where(onehot, to_sym_rec[:, None], sf.call_to_sym),
         call_value=jnp.where(onehot[:, :, None], value[:, None, :], sf.call_value),
@@ -515,7 +536,10 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
 
     r_env = jnp.zeros_like(op)
     r_env = jnp.where(op == 0x33, wk(spec.caller, WK_CALLER), r_env)
-    r_env = jnp.where(op == 0x32, wk(spec.caller, WK_ORIGIN), r_env)
+    # ORIGIN stays symbolic regardless of the caller flag: the reference
+    # models tx.origin as a free symbol in every tx (TxOrigin SWC-115
+    # detection scans for it in branch conditions)
+    r_env = jnp.where(op == 0x32, WK_ORIGIN, r_env)
     r_env = jnp.where(op == 0x34, wk(spec.callvalue, WK_CALLVALUE), r_env)
     r_env = jnp.where(op == 0x36, wk(spec.calldata, WK_CALLDATASIZE), r_env)
     r_env = jnp.where(op == 0x42, wk(spec.block_env, WK_TIMESTAMP), r_env)
@@ -531,6 +555,9 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
         r_env = jnp.where(is_cdload & (s[0] != 0), env_hv, r_env)
     r_env = jnp.where(env_hv_need & ~is_cdload, env_hv, r_env)
     r_env = jnp.where(is_rds & sf.retdata_sym, rds_leaf, r_env)
+    # the pre-seeded ORIGIN leaf exists on every tape, so "executed ORIGIN"
+    # needs its own flag (DeprecatedOperations SWC-111)
+    sf = sf.replace(origin_read=sf.origin_read | (m_env & (op == 0x32)))
 
     # ---- CLS_SHA3 (concrete args): keccak chain over the hashed window ----
     m_sha = m & (cls == ci.CLS_SHA3)
@@ -641,10 +668,13 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
             jnp.where(cap_ok & in_rv, _take_word_sym(sf.mem_sym, wm + k), rv_sym[:, k])
         )
     is_sd = op == 0xFF
+    is_inv = op == 0xFE
+    first_inv = m_halt & is_inv & (sf.inv_pc < 0)
     sf = sf.replace(
         rv_sym=rv_sym,
         sd_to_sym=jnp.where(m_halt & is_sd, s[0], sf.sd_to_sym),
         sd_to=jnp.where((m_halt & is_sd)[:, None], a[0], sf.sd_to).astype(U32),
+        inv_pc=jnp.where(first_inv, sf.base.pc, sf.inv_pc),
     )
 
     # ---- write result syms into the result slot (clears stale ids) ----

@@ -67,6 +67,7 @@ class SymFrontier:
     # --- path condition ---
     con_node: jnp.ndarray    # i32[P, C]
     con_sign: jnp.ndarray    # bool[P, C]
+    con_pc: jnp.ndarray      # i32[P, C] pc of the branch that asserted it
     con_len: jnp.ndarray     # i32[P]
     killed_infeasible: jnp.ndarray  # bool[P] pruned by constraint propagation
     # --- fork plumbing (filled by the JUMPI handler, drained by expand_forks) ---
@@ -75,7 +76,9 @@ class SymFrontier:
     dropped_forks: jnp.ndarray  # i32[P] forks lost to capacity (reported)
     # --- detection-facing event records ---
     sym_jump_dest: jnp.ndarray  # i32[P] node id of a symbolic JUMP dest (SWC-127)
+    sym_jump_pc: jnp.ndarray    # i32[P] pc of that jump (-1 = none)
     n_calls: jnp.ndarray     # i32[P]
+    n_mut_calls: jnp.ndarray  # i32[P] CALL/CALLCODE/DELEGATECALL only (re-enterable)
     call_to: jnp.ndarray     # u32[P, CL, 8] concrete callee (if concrete)
     call_to_sym: jnp.ndarray  # i32[P, CL]
     call_value: jnp.ndarray  # u32[P, CL, 8]
@@ -84,6 +87,12 @@ class SymFrontier:
     call_pc: jnp.ndarray     # i32[P, CL]
     sd_to_sym: jnp.ndarray   # i32[P] SELFDESTRUCT beneficiary sym id
     sd_to: jnp.ndarray       # u32[P, 8] concrete beneficiary
+    # one-shot event records for the remaining SWC modules
+    origin_read: jnp.ndarray  # bool[P] lane executed ORIGIN (SWC-111/115)
+    inv_pc: jnp.ndarray      # i32[P] pc of an executed INVALID (-1 = none; SWC-110)
+    sstore_after_call_pc: jnp.ndarray  # i32[P] first SSTORE after an ext call (SWC-107)
+    arb_key_node: jnp.ndarray  # i32[P] key node of first symbolic-key SSTORE (SWC-124)
+    arb_key_pc: jnp.ndarray    # i32[P]
     # symbolic-arithmetic events (IntegerArithmetics SWC-101 feed)
     n_arith: jnp.ndarray     # i32[P]
     arith_op: jnp.ndarray    # i32[P, AL] EVM opcode (ADD/SUB/MUL/EXP)
@@ -153,13 +162,16 @@ def make_sym_frontier(
         havoc_cnt=z(P),
         con_node=z(P, C),
         con_sign=jnp.zeros((P, C), dtype=bool),
+        con_pc=z(P, C),
         con_len=z(P),
         killed_infeasible=jnp.zeros(P, dtype=bool),
         fork_req=jnp.zeros(P, dtype=bool),
         fork_dest=z(P),
         dropped_forks=z(P),
         sym_jump_dest=z(P),
+        sym_jump_pc=jnp.full(P, -1, dtype=I32),
         n_calls=z(P),
+        n_mut_calls=z(P),
         call_to=jnp.zeros((P, CL, 8), dtype=U32),
         call_to_sym=z(P, CL),
         call_value=jnp.zeros((P, CL, 8), dtype=U32),
@@ -168,6 +180,11 @@ def make_sym_frontier(
         call_pc=z(P, CL),
         sd_to_sym=z(P),
         sd_to=jnp.zeros((P, 8), dtype=U32),
+        origin_read=jnp.zeros(P, dtype=bool),
+        inv_pc=jnp.full(P, -1, dtype=I32),
+        sstore_after_call_pc=jnp.full(P, -1, dtype=I32),
+        arb_key_node=z(P),
+        arb_key_pc=jnp.full(P, -1, dtype=I32),
         n_arith=z(P),
         arith_op=z(P, L.arith_log),
         arith_a=z(P, L.arith_log),
