@@ -17,14 +17,20 @@ from .report import Issue, Report
 log = logging.getLogger(__name__)
 
 
-def fire_lasers(ctx, white_list: Optional[List[str]] = None) -> Report:
+def fire_lasers(target, white_list: Optional[List[str]] = None) -> Report:
+    """`target` is an AnalysisContext or a SymExecWrapper; a wrapper's
+    per-transaction context snapshots are all scanned (module issue caches
+    dedup repeat findings across txs)."""
+    contexts = getattr(target, "tx_contexts", None) or [target]
     report = Report()
     loader = ModuleLoader()
     loader.reset_modules()
-    for module in loader.get_detection_modules(white_list):
-        try:
-            for issue in module.execute(ctx):
-                report.append(issue)
-        except Exception:  # noqa: BLE001 — degrade like the reference
-            log.exception("detection module %s failed", module.name)
+    modules = loader.get_detection_modules(white_list)
+    for ctx in contexts:
+        for module in modules:
+            try:
+                for issue in module.execute(ctx):
+                    report.append(issue)
+            except Exception:  # noqa: BLE001 — degrade like the reference
+                log.exception("detection module %s failed", module.name)
     return report

@@ -20,7 +20,7 @@ from ..disassembler import ContractImage
 from ..smt.eval import Assignment
 from ..smt.solver import solve_tape
 from ..smt.tape import HostTape, extract_tape
-from ..symbolic import SymSpec, make_sym_frontier, sym_run
+from ..symbolic import SymSpec, between_txs, make_sym_frontier, sym_run
 
 
 @dataclass
@@ -76,21 +76,24 @@ class AnalysisContext:
         return self.contract_names[cid] if cid < len(self.contract_names) else f"contract_{cid}"
 
     def tx_sequence(self, asn: Assignment) -> List[dict]:
-        """Render a witness as the reference-style concrete tx list.
-        All `calldatasize` bytes are emitted — trimming zeros would change
-        CALLDATASIZE on replay and can flip size-check branches."""
+        """Render a witness as the reference-style concrete tx list (one
+        entry per symbolic transaction). All `calldatasize` bytes are
+        emitted — trimming zeros would change CALLDATASIZE on replay and
+        can flip size-check branches."""
         from ..symbolic.ops import FreeKind
 
-        size = asn.calldatasize if asn.calldatasize is not None else len(asn.calldata)
-        size = max(0, min(size, len(asn.calldata)))
-        data = bytes(asn.calldata[:size])
         origin = asn.scalars.get((int(FreeKind.ORIGIN), 0), asn.caller)
-        return [{
-            "input": "0x" + data.hex(),
-            "value": hex(asn.callvalue),
-            "origin": hex(origin),
-            "caller": hex(asn.caller),
-        }]
+        out = []
+        for t in asn.txs:
+            size = t.calldatasize if t.calldatasize is not None else len(t.calldata)
+            size = max(0, min(size, len(t.calldata)))
+            out.append({
+                "input": "0x" + bytes(t.calldata[:size]).hex(),
+                "value": hex(t.callvalue),
+                "origin": hex(origin),
+                "caller": hex(t.caller),
+            })
+        return out
 
 
 class SymExecWrapper:
@@ -105,6 +108,7 @@ class SymExecWrapper:
         lanes_per_contract: int = 64,
         max_steps: int = 512,
         solver_iters: int = 400,
+        transaction_count: int = 1,
     ):
         self.limits = limits
         self.spec = spec
@@ -117,11 +121,21 @@ class SymExecWrapper:
         active[::lanes_per_contract] = True  # one seed lane per contract
         sf = make_sym_frontier(P, limits, contract_id=contract_id, active=active)
         env = make_env(P)
-        self.sf = sym_run(sf, env, self.corpus, spec, limits, max_steps=max_steps)
-        self.ctx = AnalysisContext(
-            sf=self.sf,
-            corpus=self.corpus,
-            limits=limits,
-            contract_names=list(contract_names or [f"contract_{i}" for i in range(C)]),
-            solver_iters=solver_iters,
-        )
+        names = list(contract_names or [f"contract_{i}" for i in range(C)])
+
+        # multi-tx outer loop (reference: execute_transactions iterating
+        # open_states ⚠unv SURVEY.md §3.2): snapshot a context after each
+        # tx so detection sees lanes that between_txs retires
+        self.tx_contexts: List[AnalysisContext] = []
+        for t in range(transaction_count):
+            sf = sym_run(sf, env, self.corpus, spec, limits, max_steps=max_steps)
+            self.tx_contexts.append(AnalysisContext(
+                sf=sf, corpus=self.corpus, limits=limits,
+                contract_names=names, solver_iters=solver_iters,
+            ))
+            if t < transaction_count - 1:
+                sf = between_txs(sf)
+                if not bool(np.asarray(sf.base.active).any()):
+                    break  # no mutating state survived: nothing to extend
+        self.sf = sf
+        self.ctx = self.tx_contexts[-1]

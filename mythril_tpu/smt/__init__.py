@@ -13,11 +13,11 @@ SURVEY.md §2); this image has no Z3, so the stack is self-built:
 """
 
 from .tape import HostTape, HostNode, extract_tape
-from .eval import Assignment, evaluate
+from .eval import Assignment, TxInput, evaluate
 from .solver import Solver, UnsatError, solve_lane
 
 __all__ = [
     "HostTape", "HostNode", "extract_tape",
-    "Assignment", "evaluate",
+    "Assignment", "TxInput", "evaluate",
     "Solver", "UnsatError", "solve_lane",
 ]

@@ -27,33 +27,23 @@ def _s(x: int) -> int:
     return x - (1 << 256) if x & SIGN else x
 
 
-@dataclass
-class Assignment:
-    """Candidate model: one shared calldata byte array + scalar vars.
+TX_STRIDE = 1 << 16  # leaf b-encoding: b = tx_id * TX_STRIDE + byte offset
 
-    Calldata leaves are byte windows over `calldata`, so overlapping
-    leaves (offset 0 vs offset 4) stay mutually consistent by
-    construction."""
+
+@dataclass
+class TxInput:
+    """One transaction's attacker-chosen inputs."""
 
     calldata: bytearray = field(default_factory=lambda: bytearray(256))
     calldatasize: Optional[int] = None  # None -> len(calldata)
-    scalars: Dict[Tuple[int, int], int] = field(default_factory=dict)
-    # STORAGE/RETVAL/HAVOC/RETDATASIZE leaves keyed by node id
-    by_node: Dict[int, int] = field(default_factory=dict)
     caller: int = ATTACKER_ADDRESS
     callvalue: int = 0
 
-    def copy(self) -> "Assignment":
-        return Assignment(
-            calldata=bytearray(self.calldata),
-            calldatasize=self.calldatasize,
-            scalars=dict(self.scalars),
-            by_node=dict(self.by_node),
-            caller=self.caller,
-            callvalue=self.callvalue,
-        )
+    def copy(self) -> "TxInput":
+        return TxInput(bytearray(self.calldata), self.calldatasize,
+                       self.caller, self.callvalue)
 
-    def read_calldata_word(self, off: int) -> int:
+    def read_word(self, off: int) -> int:
         """32-byte big-endian read, zero-padded past the effective
         calldatasize — matching concrete CALLDATALOAD so a sat witness
         can't diverge from replay on short-calldata paths."""
@@ -63,24 +53,87 @@ class Assignment:
         w = w + b"\x00" * (32 - len(w))
         return int.from_bytes(w, "big")
 
-    def write_calldata_word(self, off: int, value: int) -> None:
+    def write_word(self, off: int, value: int) -> None:
         need = off + 32
         if len(self.calldata) < need:
             self.calldata.extend(b"\x00" * (need - len(self.calldata)))
         self.calldata[off : off + 32] = (value & M256).to_bytes(32, "big")
 
 
+@dataclass
+class Assignment:
+    """Candidate model: per-transaction inputs + global scalar vars.
+
+    Calldata leaves are byte windows over the owning tx's byte array, so
+    overlapping leaves (offset 0 vs offset 4) stay mutually consistent by
+    construction. Single-tx call sites can keep using the tx-0 proxy
+    properties (calldata/caller/callvalue/calldatasize)."""
+
+    txs: List["TxInput"] = field(default_factory=lambda: [TxInput()])
+    scalars: Dict[Tuple[int, int], int] = field(default_factory=dict)
+    # STORAGE/RETVAL/HAVOC/RETDATASIZE leaves keyed by node id
+    by_node: Dict[int, int] = field(default_factory=dict)
+
+    def tx(self, i: int) -> "TxInput":
+        while len(self.txs) <= i:
+            self.txs.append(TxInput())
+        return self.txs[i]
+
+    def copy(self) -> "Assignment":
+        return Assignment(
+            txs=[t.copy() for t in self.txs],
+            scalars=dict(self.scalars),
+            by_node=dict(self.by_node),
+        )
+
+    # --- tx-0 proxies (single-tx API compatibility) ---
+    @property
+    def calldata(self) -> bytearray:
+        return self.tx(0).calldata
+
+    @property
+    def calldatasize(self) -> Optional[int]:
+        return self.tx(0).calldatasize
+
+    @calldatasize.setter
+    def calldatasize(self, v) -> None:
+        self.tx(0).calldatasize = v
+
+    @property
+    def caller(self) -> int:
+        return self.tx(0).caller
+
+    @caller.setter
+    def caller(self, v) -> None:
+        self.tx(0).caller = v
+
+    @property
+    def callvalue(self) -> int:
+        return self.tx(0).callvalue
+
+    @callvalue.setter
+    def callvalue(self, v) -> None:
+        self.tx(0).callvalue = v
+
+    def read_calldata_word(self, off: int, tx: int = 0) -> int:
+        return self.tx(tx).read_word(off)
+
+    def write_calldata_word(self, off: int, value: int, tx: int = 0) -> None:
+        self.tx(tx).write_word(off, value)
+
+
 def _free_value(node_id: int, kind: int, index: int, asn: Assignment) -> int:
     if kind == int(FreeKind.CALLDATA_WORD):
-        return asn.read_calldata_word(index)
+        return asn.tx(index // TX_STRIDE).read_word(index % TX_STRIDE)
     if kind == int(FreeKind.CALLER):
-        return asn.caller
+        return asn.tx(index).caller
     if kind == int(FreeKind.ORIGIN):
         return asn.scalars.get((kind, index), asn.caller)
     if kind == int(FreeKind.CALLVALUE):
-        return asn.callvalue
+        return asn.tx(index).callvalue
     if kind == int(FreeKind.CALLDATASIZE):
-        return asn.calldatasize if asn.calldatasize is not None else len(asn.calldata)
+        t = asn.tx(index)
+        return t.calldatasize if t.calldatasize is not None else len(t.calldata)
     if kind in (int(FreeKind.STORAGE), int(FreeKind.RETVAL), int(FreeKind.HAVOC),
                 int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH)):
         return asn.by_node.get(node_id, 0)

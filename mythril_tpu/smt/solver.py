@@ -143,18 +143,20 @@ class _Inverter:
 
 
 def _assign_leaf(node_id: int, nd, target: int, asn: Assignment) -> bool:
+    from .eval import TX_STRIDE
+
     kind = nd.a
     if kind == int(FreeKind.CALLDATA_WORD):
-        asn.write_calldata_word(nd.b, target)
+        asn.tx(nd.b // TX_STRIDE).write_word(nd.b % TX_STRIDE, target)
         return True
     if kind == int(FreeKind.CALLER):
-        asn.caller = target
+        asn.tx(nd.b).caller = target
         return True
     if kind == int(FreeKind.CALLVALUE):
-        asn.callvalue = target
+        asn.tx(nd.b).callvalue = target
         return True
     if kind == int(FreeKind.CALLDATASIZE):
-        asn.calldatasize = target
+        asn.tx(nd.b).calldatasize = target
         return True
     if kind in (int(FreeKind.STORAGE), int(FreeKind.RETVAL), int(FreeKind.HAVOC),
                 int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH)):

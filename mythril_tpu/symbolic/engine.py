@@ -33,11 +33,7 @@ from ..config import LimitsConfig, DEFAULT_LIMITS
 from ..core import interpreter as ci
 from ..core.frontier import Frontier, Env, Corpus
 from ..ops import u256
-from .ops import (
-    SymOp, FreeKind, calldata_arg_offsets,
-    WK_CALLER, WK_CALLVALUE, WK_CALLDATASIZE, WK_ORIGIN, WK_TIMESTAMP,
-    WK_NUMBER, WK_BALANCE, WK_GASPRICE, WK_PREVRANDAO, WK_CALLDATA0,
-)
+from .ops import SymOp, FreeKind, TX_STRIDE
 from .state import SymFrontier, SymSpec
 
 I32 = jnp.int32
@@ -488,36 +484,54 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     m_mod = m & (cls == ci.CLS_MODARITH)
     m_mod_sym = m_mod & ((s[0] != 0) | (s[1] != 0) | (s[2] != 0))
 
-    # ---- CLS_ENV: leaves ----
+    # ---- CLS_ENV: leaves (tx-scoped identity; dedup hits the tx-0 seeds) ----
     m_env = m & (cls == ci.CLS_ENV)
+    is_cdload = op == 0x35
     off64 = u256.to_u64_saturating(a[0]).astype(I64)
     CD = limits.calldata_bytes
-    n_args = len(calldata_arg_offsets(CD)) - 1
-    arg_i = (off64 - 4) // 32
-    wk_cd = jnp.where(
-        off64 == 0,
-        WK_CALLDATA0,
-        jnp.where(
-            (off64 >= 4) & ((off64 - 4) % 32 == 0) & (arg_i < n_args),
-            WK_CALLDATA0 + 1 + arg_i.astype(I32),
-            0,
-        ),
-    ).astype(I32)
-    is_cdload = op == 0x35
     beyond = off64 >= CD
-    need_dyn = m_env & is_cdload & (s[0] == 0) & (wk_cd == 0) & ~beyond & spec.calldata
-    sf, dyn_cd = append_node(
-        sf, need_dyn, int(SymOp.FREE), int(FreeKind.CALLDATA_WORD), off64.astype(I32)
-    )
+    txb = sf.tx_id
+
+    kind = jnp.full_like(op, -1)
+    bsel = jnp.zeros_like(op)
+
+    def leaf(enabled: bool, sel, k: int, bval):
+        nonlocal kind, bsel
+        if not enabled:
+            return
+        kind = jnp.where(sel, k, kind)
+        bsel = jnp.where(sel, bval, bsel)
+
+    # tx-scoped actor/input leaves
+    leaf(spec.caller, op == 0x33, int(FreeKind.CALLER), txb)
+    leaf(spec.callvalue, op == 0x34, int(FreeKind.CALLVALUE), txb)
+    leaf(spec.calldata, op == 0x36, int(FreeKind.CALLDATASIZE), txb)
+    leaf(spec.calldata, is_cdload & (s[0] == 0) & ~beyond,
+         int(FreeKind.CALLDATA_WORD),
+         (txb.astype(I64) * TX_STRIDE + off64).astype(I32))
+    # globals across the tx sequence: ORIGIN always symbolic (the
+    # reference models tx.origin as a free symbol; SWC-115 scans for it)
+    leaf(True, op == 0x32, int(FreeKind.ORIGIN), 0)
+    leaf(spec.block_env, op == 0x42, int(FreeKind.TIMESTAMP), 0)
+    leaf(spec.block_env, op == 0x43, int(FreeKind.NUMBER), 0)
+    leaf(spec.block_env, op == 0x44, int(FreeKind.PREVRANDAO), 0)
+    leaf(spec.block_env, op == 0x3A, int(FreeKind.GASPRICE), 0)
+    leaf(spec.block_env, op == 0x47, int(FreeKind.BALANCE), 0)
     is_balance = op == 0x31
     self_query = u256.eq(a[0], env.address) & (s[0] == 0)
     bal_self = is_balance & self_query
-    # EXTCODESIZE/EXTCODEHASH of anything but a concrete self-address is
-    # unknown until world-state integration: havoc, NOT concrete 0 — a
-    # wrong concrete value would silently prune feasible branches
-    # (isContract checks).
+    leaf(spec.block_env, bal_self, int(FreeKind.BALANCE), 0)
+    # RETURNDATASIZE after a symbolic call
+    leaf(True, (op == 0x3D) & sf.retdata_sym, int(FreeKind.RETDATASIZE),
+         jnp.maximum(sf.n_calls - 1, 0))
+
+    need_leaf = m_env & (kind >= 0)
+    sf, env_leaf = append_node(sf, need_leaf, int(SymOp.FREE), kind, bsel)
+
+    # havoc cases: unknowable values must never collapse to a wrong
+    # concrete 0 (EXTCODESIZE/EXTCODEHASH of unknown addresses, BALANCE of
+    # others, BLOCKHASH, symbolic-offset CALLDATALOAD)
     ext_query = (op == 0x3B) | (op == 0x3F)
-    is_rds = op == 0x3D  # RETURNDATASIZE after a symbolic call
     env_hv_need = m_env & (
         (is_cdload & (s[0] != 0))
         | (is_balance & ~bal_self)
@@ -525,38 +539,10 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
         | (ext_query & ~self_query)
     )
     sf, env_hv = _havoc(sf, env_hv_need)
-    sf, rds_leaf = append_node(
-        sf, m_env & is_rds & sf.retdata_sym,
-        int(SymOp.FREE), int(FreeKind.RETDATASIZE),
-        jnp.maximum(sf.n_calls - 1, 0),
-    )
-
-    def wk(flag: bool, wid: int):
-        return wid if flag else 0
-
-    r_env = jnp.zeros_like(op)
-    r_env = jnp.where(op == 0x33, wk(spec.caller, WK_CALLER), r_env)
-    # ORIGIN stays symbolic regardless of the caller flag: the reference
-    # models tx.origin as a free symbol in every tx (TxOrigin SWC-115
-    # detection scans for it in branch conditions)
-    r_env = jnp.where(op == 0x32, WK_ORIGIN, r_env)
-    r_env = jnp.where(op == 0x34, wk(spec.callvalue, WK_CALLVALUE), r_env)
-    r_env = jnp.where(op == 0x36, wk(spec.calldata, WK_CALLDATASIZE), r_env)
-    r_env = jnp.where(op == 0x42, wk(spec.block_env, WK_TIMESTAMP), r_env)
-    r_env = jnp.where(op == 0x43, wk(spec.block_env, WK_NUMBER), r_env)
-    r_env = jnp.where(op == 0x44, wk(spec.block_env, WK_PREVRANDAO), r_env)
-    r_env = jnp.where(op == 0x3A, wk(spec.block_env, WK_GASPRICE), r_env)
-    r_env = jnp.where(op == 0x47, wk(spec.block_env, WK_BALANCE), r_env)
-    r_env = jnp.where(bal_self, wk(spec.block_env, WK_BALANCE), r_env)
-    if spec.calldata:
-        r_cd = jnp.where(s[0] != 0, env_hv, jnp.where(wk_cd != 0, wk_cd, jnp.where(beyond, 0, dyn_cd)))
-        r_env = jnp.where(is_cdload, r_cd, r_env)
-    else:
-        r_env = jnp.where(is_cdload & (s[0] != 0), env_hv, r_env)
-    r_env = jnp.where(env_hv_need & ~is_cdload, env_hv, r_env)
-    r_env = jnp.where(is_rds & sf.retdata_sym, rds_leaf, r_env)
-    # the pre-seeded ORIGIN leaf exists on every tape, so "executed ORIGIN"
-    # needs its own flag (DeprecatedOperations SWC-111)
+    r_env = jnp.where(need_leaf, env_leaf, 0)
+    r_env = jnp.where(env_hv_need, env_hv, r_env)
+    # "executed ORIGIN" flag (DeprecatedOperations SWC-111): the leaf node
+    # may pre-exist via seeding, so presence on the tape is not evidence
     sf = sf.replace(origin_read=sf.origin_read | (m_env & (op == 0x32)))
 
     # ---- CLS_SHA3 (concrete args): keccak chain over the hashed window ----
@@ -755,6 +741,53 @@ def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
 
     f = ci.epilogue(sf.base, op, run, old_pc)
     return sf.replace(base=f)
+
+
+def between_txs(sf: SymFrontier) -> SymFrontier:
+    """Advance surviving lanes to the next symbolic transaction.
+
+    Counterpart of the reference's ``open_states`` handoff
+    (``transaction/symbolic.py:execute_message_call`` iterating world
+    states that survived the previous tx ⚠unv, SURVEY.md §3.2): a lane
+    proceeds iff it halted normally AND mutated storage — dropping
+    non-mutating paths is exactly the reference's MutationPruner
+    (``laser/plugin/plugins/mutation_pruner.py`` ⚠unv). Per-tx machine
+    state resets; storage, the tape, path constraints, and event logs
+    carry over. tx-scoped leaves re-key via tx_id (TX_STRIDE encoding).
+    """
+    b = sf.base
+    P = sf.n_lanes
+    mutated = jnp.any(b.st_written, axis=1)
+    go = b.active & b.halted & ~b.error & ~b.reverted & mutated
+    return sf.replace(
+        base=b.replace(
+            active=go,
+            halted=jnp.zeros_like(b.halted),
+            reverted=jnp.zeros_like(b.reverted),
+            pc=jnp.where(go, 0, b.pc),
+            stack=jnp.where(go[:, None, None], 0, b.stack),
+            sp=jnp.where(go, 0, b.sp),
+            memory=jnp.where(go[:, None], 0, b.memory),
+            mem_words=jnp.where(go, 0, b.mem_words),
+            gas_min=jnp.where(go, 0, b.gas_min),
+            gas_max=jnp.where(go, 0, b.gas_max),
+            calldata_len=jnp.where(go, b.calldata.shape[1], b.calldata_len),
+            returndata_len=jnp.where(go, 0, b.returndata_len),
+            retval_len=jnp.where(go, 0, b.retval_len),
+            n_logs=jnp.where(go, 0, b.n_logs),
+            st_written=jnp.where(go[:, None], False, b.st_written),
+        ),
+        stack_sym=jnp.where(go[:, None], 0, sf.stack_sym),
+        mem_sym=jnp.where(go[:, None], 0, sf.mem_sym),
+        mem_havoc=jnp.where(go, False, sf.mem_havoc),
+        retdata_sym=jnp.where(go, False, sf.retdata_sym),
+        rv_sym=jnp.where(go[:, None], 0, sf.rv_sym),
+        tx_id=jnp.where(go, sf.tx_id + 1, sf.tx_id),
+        # retired lanes (reverted / error / non-mutating) free their slots
+        # for forks of the surviving ones; their results were consumed by
+        # the per-tx detection pass before this call
+        killed_infeasible=sf.killed_infeasible,
+    )
 
 
 def expand_forks(sf: SymFrontier) -> SymFrontier:

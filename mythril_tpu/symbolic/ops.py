@@ -71,6 +71,14 @@ class FreeKind(IntEnum):
     RETDATASIZE = 15    # returndata size of an external call; b = call index
 
 
+# Multi-transaction leaf identity: tx-scoped leaves encode the transaction
+# index in `b` — calldata words as b = tx_id * TX_STRIDE + byte_offset,
+# caller/callvalue/calldatasize as b = tx_id. Tx 0 therefore has b == the
+# plain offset/0, which is exactly what the pre-seeded rows below carry, so
+# hash-consing dedups first-tx reads onto the seeds. ORIGIN and the block
+# environment stay global (b = 0) across the sequence.
+TX_STRIDE = 1 << 16
+
 # Well-known leaves pre-seeded on the tape at fixed ids so the hot paths
 # (CALLDATALOAD, CALLER, CALLVALUE) never need an append. Layout:
 #   id 0              NULL (concrete zero)

@@ -65,6 +65,7 @@ class SymFrontier:
     tape_len: jnp.ndarray    # i32[P]
     havoc_cnt: jnp.ndarray   # i32[P] fresh-variable counter (HAVOC uniqueness)
     # --- path condition ---
+    tx_id: jnp.ndarray       # i32[P] current transaction index (0-based)
     con_node: jnp.ndarray    # i32[P, C]
     con_sign: jnp.ndarray    # bool[P, C]
     con_pc: jnp.ndarray      # i32[P, C] pc of the branch that asserted it
@@ -160,6 +161,7 @@ def make_sym_frontier(
         tape_imm=jnp.zeros((P, T, 8), dtype=U32),
         tape_len=jnp.full(P, n_wk, dtype=I32),
         havoc_cnt=z(P),
+        tx_id=z(P),
         con_node=z(P, C),
         con_sign=jnp.zeros((P, C), dtype=bool),
         con_pc=z(P, C),
