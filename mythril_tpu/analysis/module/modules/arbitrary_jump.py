@@ -40,6 +40,10 @@ class ArbitraryJump(DetectionModule):
                 continue
             tape = ctx.tape(lane)
             if not attacker_controlled(tape, node):
+                # _seen inserted the key; release it so a later lane with an
+                # attacker-controlled destination at the same (cid, pc) is
+                # not suppressed
+                self._cache.discard((cid, pc))
                 continue
             asn = ctx.solve(lane)
             if asn is None:

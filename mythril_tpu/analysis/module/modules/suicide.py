@@ -29,9 +29,9 @@ class AccidentallyKillable(DetectionModule):
         issues: List[Issue] = []
         sd = np.asarray(ctx.sf.base.selfdestructed)
         sd_sym = np.asarray(ctx.sf.sd_to_sym)
-        pcs = np.asarray(ctx.sf.base.pc)
+        pcs = np.asarray(ctx.sf.sd_pc)  # recorded SELFDESTRUCT pc, not live pc
         for lane in ctx.lanes():
-            if not bool(sd[lane]):
+            if not bool(sd[lane]) or int(pcs[lane]) < 0:
                 continue
             cid = ctx.contract_of(lane)
             pc = int(pcs[lane])

@@ -549,23 +549,31 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     m_sha = m & (cls == ci.CLS_SHA3)
     ln64 = u256.to_u64_saturating(a[1]).astype(I64)
     w0 = (off64 // 32).astype(I32)
-    nw = jnp.clip((off64 % 32 + ln64 + 31) // 32, 0, 8).astype(I32)
+    # chain span derived from the concrete handler's hash cap so they can't
+    # drift: any ln the concrete handler accepts (<= MAX_HASH_BYTES, else
+    # the lane errors there) fits in NCW words from w0
+    NCW = (ci.MAX_HASH_BYTES + 31 + 31) // 32
+    nw = jnp.clip((off64 % 32 + ln64 + 31) // 32, 0, NCW).astype(I32)
     wsyms = [
-        _take_word_sym(sf.mem_sym, w0 + k) for k in range(8)
+        _take_word_sym(sf.mem_sym, w0 + k) for k in range(NCW)
     ]
-    in_win = [(jnp.int32(k) < nw) for k in range(8)]
+    in_win = [(jnp.int32(k) < nw) for k in range(NCW)]
     any_w_sym = jnp.zeros_like(m_sha)
-    for k in range(8):
+    for k in range(NCW):
         any_w_sym = any_w_sym | (in_win[k] & (wsyms[k] != 0))
-    m_hvsha = m_sha & sf.mem_havoc & (ln64 > 0)
-    m_chain = m_sha & any_w_sym & ~sf.mem_havoc
+    # a window that does not fully fit the chain span would truncate the
+    # hashed data and yield a WRONG digest downstream — havoc instead
+    # (over-approximation policy: never a wrong value)
+    fits_chain = (off64 % 32 + ln64) <= 32 * NCW
+    m_hvsha = m_sha & (ln64 > 0) & (sf.mem_havoc | (any_w_sym & ~fits_chain))
+    m_chain = m_sha & any_w_sym & ~sf.mem_havoc & fits_chain
     sf, sha_hv = _havoc(sf, m_hvsha)
     seed_imm = jnp.zeros((f.pc.shape[0], 8), dtype=U32)
     seed_imm = seed_imm.at[:, 0].set(jnp.clip(ln64, 0, 2**31).astype(U32))
     seed_imm = seed_imm.at[:, 1].set((off64 % 32).astype(U32))
     sf, chain = append_node(sf, m_chain, int(SymOp.KECCAK_SEED), 0, 0, seed_imm)
     M = f.memory.shape[1]
-    for k in range(8):
+    for k in range(NCW):
         mk = m_chain & in_win[k]
         w_conc = ci._be_bytes_to_word(
             ci._gather_bytes(sf.base.memory, (w0 + k).astype(I64) * 32, 32,
@@ -656,10 +664,12 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     is_sd = op == 0xFF
     is_inv = op == 0xFE
     first_inv = m_halt & is_inv & (sf.inv_pc < 0)
+    first_sd = m_halt & is_sd & (sf.sd_pc < 0)
     sf = sf.replace(
         rv_sym=rv_sym,
         sd_to_sym=jnp.where(m_halt & is_sd, s[0], sf.sd_to_sym),
         sd_to=jnp.where((m_halt & is_sd)[:, None], a[0], sf.sd_to).astype(U32),
+        sd_pc=jnp.where(first_sd, sf.base.pc, sf.sd_pc),
         inv_pc=jnp.where(first_inv, sf.base.pc, sf.inv_pc),
     )
 
@@ -751,14 +761,18 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
     states that survived the previous tx ⚠unv, SURVEY.md §3.2): a lane
     proceeds iff it halted normally AND mutated storage — dropping
     non-mutating paths is exactly the reference's MutationPruner
-    (``laser/plugin/plugins/mutation_pruner.py`` ⚠unv). Per-tx machine
-    state resets; storage, the tape, path constraints, and event logs
-    carry over. tx-scoped leaves re-key via tx_id (TX_STRIDE encoding).
+    (``laser/plugin/plugins/mutation_pruner.py`` ⚠unv). A selfdestructed
+    contract has no code left, so those lanes retire too. Per-tx machine
+    state resets; storage, the tape, and path constraints carry over;
+    the one-shot event records (calls, arith, INVALID/SSTORE pcs) are
+    per-transaction and reset — the per-tx context snapshots taken by
+    ``SymExecWrapper`` already preserved them for detection.
+    tx-scoped leaves re-key via tx_id (TX_STRIDE encoding).
     """
     b = sf.base
     P = sf.n_lanes
     mutated = jnp.any(b.st_written, axis=1)
-    go = b.active & b.halted & ~b.error & ~b.reverted & mutated
+    go = b.active & b.halted & ~b.error & ~b.reverted & ~b.selfdestructed & mutated
     return sf.replace(
         base=b.replace(
             active=go,
@@ -783,6 +797,30 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         retdata_sym=jnp.where(go, False, sf.retdata_sym),
         rv_sym=jnp.where(go[:, None], 0, sf.rv_sym),
         tx_id=jnp.where(go, sf.tx_id + 1, sf.tx_id),
+        # per-tx one-shot event records reset so tx N+1 can't inherit
+        # tx N's calls/arith/SSTORE-after-call evidence (the per-tx
+        # snapshot consumed them already)
+        sym_jump_dest=jnp.where(go, 0, sf.sym_jump_dest),
+        sym_jump_pc=jnp.where(go, -1, sf.sym_jump_pc),
+        n_calls=jnp.where(go, 0, sf.n_calls),
+        n_mut_calls=jnp.where(go, 0, sf.n_mut_calls),
+        call_op=jnp.where(go[:, None], 0, sf.call_op),
+        call_to=jnp.where(go[:, None, None], 0, sf.call_to),
+        call_to_sym=jnp.where(go[:, None], 0, sf.call_to_sym),
+        call_value=jnp.where(go[:, None, None], 0, sf.call_value),
+        call_value_sym=jnp.where(go[:, None], 0, sf.call_value_sym),
+        call_pc=jnp.where(go[:, None], 0, sf.call_pc),
+        origin_read=jnp.where(go, False, sf.origin_read),
+        inv_pc=jnp.where(go, -1, sf.inv_pc),
+        sstore_after_call_pc=jnp.where(go, -1, sf.sstore_after_call_pc),
+        arb_key_node=jnp.where(go, 0, sf.arb_key_node),
+        arb_key_pc=jnp.where(go, -1, sf.arb_key_pc),
+        n_arith=jnp.where(go, 0, sf.n_arith),
+        arith_op=jnp.where(go[:, None], 0, sf.arith_op),
+        arith_a=jnp.where(go[:, None], 0, sf.arith_a),
+        arith_b=jnp.where(go[:, None], 0, sf.arith_b),
+        arith_r=jnp.where(go[:, None], 0, sf.arith_r),
+        arith_pc=jnp.where(go[:, None], 0, sf.arith_pc),
         # retired lanes (reverted / error / non-mutating) free their slots
         # for forks of the surviving ones; their results were consumed by
         # the per-tx detection pass before this call

@@ -88,6 +88,7 @@ class SymFrontier:
     call_pc: jnp.ndarray     # i32[P, CL]
     sd_to_sym: jnp.ndarray   # i32[P] SELFDESTRUCT beneficiary sym id
     sd_to: jnp.ndarray       # u32[P, 8] concrete beneficiary
+    sd_pc: jnp.ndarray       # i32[P] pc of the first SELFDESTRUCT (-1 = none)
     # one-shot event records for the remaining SWC modules
     origin_read: jnp.ndarray  # bool[P] lane executed ORIGIN (SWC-111/115)
     inv_pc: jnp.ndarray      # i32[P] pc of an executed INVALID (-1 = none; SWC-110)
@@ -182,6 +183,7 @@ def make_sym_frontier(
         call_pc=z(P, CL),
         sd_to_sym=z(P),
         sd_to=jnp.zeros((P, 8), dtype=U32),
+        sd_pc=jnp.full(P, -1, dtype=I32),
         origin_read=jnp.zeros(P, dtype=bool),
         inv_pc=jnp.full(P, -1, dtype=I32),
         sstore_after_call_pc=jnp.full(P, -1, dtype=I32),
