@@ -61,6 +61,10 @@ class Issue:
 class Report:
     issues: List[Issue] = field(default_factory=list)
     contract_name: str = ""
+    # lost-coverage accounting from analysis.symbolic.coverage_summary —
+    # lanes errored per cap, dropped forks, saturated event logs. Rendered
+    # as warnings so silent-loss parity gaps are auditable.
+    coverage: Optional[Dict] = None
 
     def append(self, issue: Issue) -> None:
         self.issues.append(issue)
@@ -68,10 +72,46 @@ class Report:
     def sorted(self) -> List[Issue]:
         return sorted(self.issues, key=lambda i: (i.address, i.swc_id))
 
+    def coverage_warnings(self) -> List[str]:
+        cov = self.coverage or {}
+        warn = []
+        if cov.get("lanes_lost_to_caps"):
+            from ..core.frontier import CAP_TRAPS, TRAP_NAMES
+
+            cap_names = {TRAP_NAMES[c] for c in CAP_TRAPS}
+            caps = {k: v for k, v in cov.get("lanes_errored", {}).items()
+                    if k in cap_names}
+            warn.append(
+                f"{cov['lanes_lost_to_caps']} lane(s) lost to engine capacity "
+                f"caps ({caps}); findings on those paths are missed."
+            )
+        if cov.get("dropped_forks"):
+            warn.append(
+                f"{cov['dropped_forks']} fork(s) dropped: frontier had no free "
+                "lanes; unexplored branches exist."
+            )
+        if cov.get("saturated_call_logs"):
+            warn.append(
+                f"{cov['saturated_call_logs']} lane(s) saturated the external-"
+                "call event log; later calls were not recorded."
+            )
+        if cov.get("saturated_arith_logs"):
+            warn.append(
+                f"{cov['saturated_arith_logs']} lane(s) saturated the arithmetic "
+                "event log; later overflow candidates were not recorded."
+            )
+        return warn
+
     def as_text(self) -> str:
         if not self.issues:
-            return "The analysis was completed successfully. No issues were detected.\n"
+            base = "The analysis was completed successfully. No issues were detected.\n"
+            warns = self.coverage_warnings()
+            if warns:
+                base += "".join(f"WARNING: {w}\n" for w in warns)
+            return base
         out = []
+        for w in self.coverage_warnings():
+            out.append(f"WARNING: {w}")
         for i in self.sorted():
             out.append(f"==== {i.title} ====")
             out.append(f"SWC ID: {i.swc_id}")
@@ -87,9 +127,12 @@ class Report:
         return "\n".join(out)
 
     def as_markdown(self) -> str:
+        warns = "".join(f"> **Warning:** {w}\n" for w in self.coverage_warnings())
         if not self.issues:
-            return "# Analysis results\n\nNo issues found.\n"
+            return "# Analysis results\n\n" + warns + "\nNo issues found.\n"
         out = ["# Analysis results\n"]
+        if warns:
+            out.append(warns)
         for i in self.sorted():
             out.append(f"## {i.title}")
             out.append(f"- SWC ID: {i.swc_id}")
@@ -104,6 +147,7 @@ class Report:
                 "success": True,
                 "error": None,
                 "issues": [i.as_dict() for i in self.sorted()],
+                "coverage": self.coverage,
             },
             sort_keys=True,
         )

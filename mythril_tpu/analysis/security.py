@@ -13,6 +13,7 @@ from typing import List, Optional
 
 from .module.loader import ModuleLoader
 from .report import Issue, Report
+from .symbolic import coverage_summary
 
 log = logging.getLogger(__name__)
 
@@ -23,6 +24,10 @@ def fire_lasers(target, white_list: Optional[List[str]] = None) -> Report:
     dedup repeat findings across txs)."""
     contexts = getattr(target, "tx_contexts", None) or [target]
     report = Report()
+    try:
+        report.coverage = coverage_summary(contexts)
+    except Exception:  # noqa: BLE001 — accounting must not kill the run
+        log.exception("coverage accounting failed")
     loader = ModuleLoader()
     loader.reset_modules()
     modules = loader.get_detection_modules(white_list)

@@ -16,19 +16,21 @@ from dataclasses import dataclass
 class LimitsConfig:
     """Shape caps for one frontier. All sizes static at trace time."""
 
-    max_stack: int = 64  # EVM allows 1024; real contracts stay far below
+    max_stack: int = 256  # EVM allows 1024; solc output stays far below —
+    # deep real-world frames trip ~30-60; 256 leaves 4x headroom and any
+    # trip is attributed in the report coverage block (Trap.STACK)
     mem_bytes: int = 4096  # byte-addressable memory cap per lane
     calldata_bytes: int = 256  # symbolic tx calldata cap
     returndata_bytes: int = 256
-    storage_slots: int = 32  # associative storage-cache entries per lane
+    storage_slots: int = 64  # associative storage-cache entries per lane
     max_code: int = 24576  # EIP-170 runtime-code limit
     max_hash_bytes: int = 200  # SHA3 input cap (mapping keys are 64 bytes)
     log_slots: int = 8  # recorded LOG entries per lane
     tape_len: int = 512  # symbolic SSA tape nodes per lane
-    max_constraints: int = 64  # path-condition slots per lane
+    max_constraints: int = 128  # path-condition slots per lane
     call_depth: int = 4  # saved call contexts per lane
-    call_log: int = 8  # recorded external-call events per lane
-    arith_log: int = 16  # recorded symbolic-arithmetic events per lane
+    call_log: int = 16  # recorded external-call events per lane
+    arith_log: int = 32  # recorded symbolic-arithmetic events per lane
     propagate_every: int = 8  # supersteps between feasibility sweeps
 
     def __post_init__(self):

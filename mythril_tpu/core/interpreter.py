@@ -31,7 +31,7 @@ from ..config import LimitsConfig, DEFAULT_LIMITS
 from ..disassembler import opcodes as oc
 from ..ops import u256
 from ..ops.keccak import keccak256_device
-from .frontier import Frontier, Env, Corpus
+from .frontier import Frontier, Env, Corpus, Trap
 
 I64 = jnp.int64
 I32 = jnp.int32
@@ -168,8 +168,7 @@ def _expand_memory(f: Frontier, mask, end_bytes) -> Tuple[Frontier, jnp.ndarray]
             mem_words=new_words.astype(I32),
             gas_min=f.gas_min + jnp.where(mask, delta, 0),
             gas_max=f.gas_max + jnp.where(mask, delta, 0),
-            error=f.error | oob,
-        ),
+        ).trap(oob, Trap.OOB_MEM),
         oob,
     )
 
@@ -335,8 +334,7 @@ def _h_sha3(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     return f.replace(
         stack=stack,
         sp=jnp.where(m, f.sp - 1, f.sp),
-        error=f.error | too_long,
-    )
+    ).trap(too_long, Trap.HASH_LIMIT)
 
 
 def _h_env(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -504,8 +502,8 @@ def _h_storage(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     sp = jnp.where(m & is_store, f.sp - 2, f.sp)
     return f.replace(
         stack=stack, sp=sp, st_keys=st_keys, st_vals=st_vals,
-        st_used=st_used, st_written=st_written, error=f.error | overflow,
-    )
+        st_used=st_used, st_written=st_written,
+    ).trap(overflow, Trap.STORAGE_SLOTS)
 
 
 def _h_jump(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -518,7 +516,7 @@ def _h_jump(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     new_pc = jnp.where(taken, dest.astype(I32), old_pc + 1)
     pc = jnp.where(m & ~bad, new_pc, f.pc)
     d_sp = jnp.where(is_jumpi, 2, 1)
-    return f.replace(pc=pc, sp=jnp.where(m, f.sp - d_sp, f.sp), error=f.error | bad)
+    return f.replace(pc=pc, sp=jnp.where(m, f.sp - d_sp, f.sp)).trap(bad, Trap.BAD_JUMP)
 
 
 def _h_halt(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -543,9 +541,8 @@ def _h_halt(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     gas_min = jnp.where(m & is_invalid, f.gas_limit, f.gas_min)
     gas_max = jnp.where(m & is_invalid, f.gas_limit, f.gas_max)
 
-    return f.replace(
+    return f.trap(m & is_invalid, Trap.INVALID_OP).replace(
         halted=f.halted | (m & ~is_invalid),
-        error=f.error | (m & is_invalid),
         reverted=f.reverted | (m & is_revert),
         selfdestructed=f.selfdestructed | (m & is_sd),
         retval=retval,
@@ -623,11 +620,12 @@ def prologue(f: Frontier, corpus: Corpus):
 
     sin = _J_STACK_IN[op]
     sout = _J_STACK_OUT[op]
-    bad = running & (
-        (f.sp < sin) | (f.sp - sin + sout > f.max_stack) | ~_J_IS_VALID[op]
+    invalid = running & ~_J_IS_VALID[op]
+    stack_bad = running & _J_IS_VALID[op] & (
+        (f.sp < sin) | (f.sp - sin + sout > f.max_stack)
     )
-    f = f.replace(error=f.error | bad)
-    run = running & ~bad
+    f = f.trap(invalid, Trap.INVALID_OP).trap(stack_bad, Trap.STACK)
+    run = running & ~invalid & ~stack_bad
 
     f = f.replace(
         gas_min=f.gas_min + jnp.where(run, _J_GAS_MIN[op], 0),
@@ -661,7 +659,7 @@ def epilogue(f: Frontier, op, run, old_pc) -> Frontier:
     next_pc = old_pc + 1 + _J_PUSH_WIDTH[op]
     f = f.replace(pc=jnp.where(advanced, next_pc, f.pc))
     oog = run & (f.gas_min > f.gas_limit)
-    return f.replace(error=f.error | oog)
+    return f.trap(oog, Trap.OOG)
 
 
 def superstep(f: Frontier, env: Env, corpus: Corpus) -> Frontier:

@@ -31,7 +31,7 @@ from jax import lax
 
 from ..config import LimitsConfig, DEFAULT_LIMITS
 from ..core import interpreter as ci
-from ..core.frontier import Frontier, Env, Corpus
+from ..core.frontier import Frontier, Env, Corpus, Trap
 from ..ops import u256
 from .ops import SymOp, FreeKind, TX_STRIDE
 from .state import SymFrontier, SymSpec
@@ -107,7 +107,7 @@ def append_node(sf: SymFrontier, mask, op, a, b, imm=None):
             tape_b=jnp.where(onehot, b[:, None], sf.tape_b),
             tape_imm=jnp.where(onehot[:, :, None], imm[:, None, :], sf.tape_imm),
             tape_len=sf.tape_len + write.astype(I32),
-            base=sf.base.replace(error=sf.base.error | overflow),
+            base=sf.base.trap(overflow, Trap.TAPE_LIMIT),
         ),
         ids,
     )
@@ -159,7 +159,7 @@ def _append_constraint(sf: SymFrontier, mask, node, sign, pc):
         con_sign=jnp.where(onehot, sign[:, None], sf.con_sign),
         con_pc=jnp.where(onehot, pc[:, None], sf.con_pc),
         con_len=sf.con_len + write.astype(I32),
-        base=sf.base.replace(error=sf.base.error | overflow),
+        base=sf.base.trap(overflow, Trap.CONSTRAINT_LIMIT),
     )
 
 
@@ -235,8 +235,7 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
             st_vals=jnp.where(onehot[:, :, None], val[:, None, :], f.st_vals),
             st_used=f.st_used | onehot,
             st_written=f.st_written | onehot,
-            error=f.error | overflow,
-        ),
+        ).trap(overflow, Trap.STORAGE_SLOTS),
         stack_sym=stack_sym,
         st_key_sym=jnp.where(onehot, key_sym[:, None], sf.st_key_sym),
         st_val_sym=jnp.where(onehot, val_sym[:, None], sf.st_val_sym),
@@ -301,9 +300,8 @@ def _h_sym_jump(sf: SymFrontier, corpus: Corpus, op, m, old_pc, known, ksign) ->
         base=f.replace(
             pc=jnp.where(move, new_pc, f.pc),
             sp=jnp.where(m, f.sp - d_sp, f.sp),
-            error=f.error | bad,
             halted=f.halted | sym_taken,
-        ),
+        ).trap(bad, Trap.BAD_JUMP),
         sym_jump_dest=jnp.where(sym_taken | sym_unres, dest_sym, sf.sym_jump_dest),
         sym_jump_pc=jnp.where(sym_taken | sym_unres, old_pc, sf.sym_jump_pc),
         fork_req=sf.fork_req | fork_ok,
@@ -777,6 +775,7 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         base=b.replace(
             active=go,
             halted=jnp.zeros_like(b.halted),
+            err_code=jnp.zeros_like(b.err_code),
             reverted=jnp.zeros_like(b.reverted),
             pc=jnp.where(go, 0, b.pc),
             stack=jnp.where(go[:, None, None], 0, b.stack),
@@ -815,6 +814,7 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         sstore_after_call_pc=jnp.where(go, -1, sf.sstore_after_call_pc),
         arb_key_node=jnp.where(go, 0, sf.arb_key_node),
         arb_key_pc=jnp.where(go, -1, sf.arb_key_pc),
+        dropped_forks=jnp.zeros_like(sf.dropped_forks),
         n_arith=jnp.where(go, 0, sf.n_arith),
         arith_op=jnp.where(go[:, None], 0, sf.arith_op),
         arith_a=jnp.where(go[:, None], 0, sf.arith_a),
@@ -823,8 +823,11 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         arith_pc=jnp.where(go[:, None], 0, sf.arith_pc),
         # retired lanes (reverted / error / non-mutating) free their slots
         # for forks of the surviving ones; their results were consumed by
-        # the per-tx detection pass before this call
-        killed_infeasible=sf.killed_infeasible,
+        # the per-tx detection pass before this call. Loss accounting
+        # (err_code / killed_infeasible) resets so the host-side per-tx
+        # tally in SymExecWrapper counts each lost lane exactly once even
+        # after its slot is recycled by expand_forks.
+        killed_infeasible=jnp.zeros_like(sf.killed_infeasible),
     )
 
 
@@ -844,11 +847,18 @@ def expand_forks(sf: SymFrontier) -> SymFrontier:
     src = jnp.arange(P, dtype=I32).at[slot].set(jnp.arange(P, dtype=I32), mode="drop")
     is_copy = jnp.zeros(P, dtype=bool).at[slot].set(True, mode="drop")
 
-    new = jax.tree.map(lambda x: jnp.take(x, src, axis=0), sf)
+    # scalar run-total counters pass through untouched (ndim == 0); they
+    # must not be gathered over the lane axis
+    new = jax.tree.map(
+        lambda x: x if x.ndim == 0 else jnp.take(x, src, axis=0), sf
+    )
     b = new.base
     C = new.con_sign.shape[1]
     last = (jnp.arange(C)[None, :] == (new.con_len - 1)[:, None]) & is_copy[:, None]
-    dropped = new.dropped_forks + (req & (slot == P)).astype(I32)
+    # fork copies must not inherit the source lane's loss counter — that
+    # would double-count every prior drop once per fork
+    n_dropped = (req & (slot == P)).astype(I32)
+    dropped = jnp.where(is_copy, 0, new.dropped_forks) + n_dropped
     return new.replace(
         base=b.replace(
             pc=jnp.where(is_copy, new.fork_dest, b.pc),
@@ -857,6 +867,7 @@ def expand_forks(sf: SymFrontier) -> SymFrontier:
         con_sign=jnp.where(last, True, new.con_sign),
         fork_req=jnp.zeros_like(new.fork_req),
         dropped_forks=dropped,
+        dropped_total=new.dropped_total + jnp.sum(n_dropped, dtype=I32),
     )
 
 

@@ -241,4 +241,5 @@ def kill_infeasible(sf: SymFrontier) -> SymFrontier:
     return sf.replace(
         base=sf.base.replace(active=sf.base.active & ~inf),
         killed_infeasible=sf.killed_infeasible | inf,
+        killed_total=sf.killed_total + jnp.sum(inf, dtype=jnp.int32),
     )

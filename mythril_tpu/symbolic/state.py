@@ -71,10 +71,13 @@ class SymFrontier:
     con_pc: jnp.ndarray      # i32[P, C] pc of the branch that asserted it
     con_len: jnp.ndarray     # i32[P]
     killed_infeasible: jnp.ndarray  # bool[P] pruned by constraint propagation
+    killed_total: jnp.ndarray  # i32[] run total of propagation kills (survives
+    # lane recycling — per-lane flags are lost when expand_forks reuses a slot)
     # --- fork plumbing (filled by the JUMPI handler, drained by expand_forks) ---
     fork_req: jnp.ndarray    # bool[P]
     fork_dest: jnp.ndarray   # i32[P] jump target of the taken branch
     dropped_forks: jnp.ndarray  # i32[P] forks lost to capacity (reported)
+    dropped_total: jnp.ndarray  # i32[] run total of dropped forks
     # --- detection-facing event records ---
     sym_jump_dest: jnp.ndarray  # i32[P] node id of a symbolic JUMP dest (SWC-127)
     sym_jump_pc: jnp.ndarray    # i32[P] pc of that jump (-1 = none)
@@ -168,9 +171,11 @@ def make_sym_frontier(
         con_pc=z(P, C),
         con_len=z(P),
         killed_infeasible=jnp.zeros(P, dtype=bool),
+        killed_total=jnp.zeros((), dtype=I32),
         fork_req=jnp.zeros(P, dtype=bool),
         fork_dest=z(P),
         dropped_forks=z(P),
+        dropped_total=jnp.zeros((), dtype=I32),
         sym_jump_dest=z(P),
         sym_jump_pc=jnp.full(P, -1, dtype=I32),
         n_calls=z(P),

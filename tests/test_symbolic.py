@@ -203,6 +203,7 @@ def test_fork_capacity_drops_are_counted():
     )
     sf = srun(code, n_lanes=2, active_lanes=1)
     assert int(np.asarray(sf.dropped_forks).sum()) >= 1
+    assert int(np.asarray(sf.dropped_total)) >= 1
 
 
 def test_extcodesize_of_unknown_address_is_symbolic():
