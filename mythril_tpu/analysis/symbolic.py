@@ -101,6 +101,15 @@ class AnalysisContext:
         return out
 
 
+def _count_traps(err_code: np.ndarray) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for code, name in TRAP_NAMES.items():
+        n = int((err_code == code).sum())
+        if n:
+            out[name] = n
+    return out
+
+
 def coverage_summary(tx_contexts) -> dict:
     """Lost-coverage accounting over a run's per-tx context snapshots.
 
@@ -119,11 +128,7 @@ def coverage_summary(tx_contexts) -> dict:
             for name, n in c.trap_counts.items():
                 errored[name] = errored.get(name, 0) + n
     else:
-        err_code = np.asarray(final.base.err_code)
-        for code, name in TRAP_NAMES.items():
-            n = int((err_code == code).sum())
-            if n:
-                errored[name] = n
+        errored = _count_traps(np.asarray(final.base.err_code))
     cap_names = {TRAP_NAMES[c] for c in CAP_TRAPS}
     cap_lost = sum(n for name, n in errored.items() if name in cap_names)
     # event logs reset per tx, so saturation counts sum across snapshots
@@ -182,12 +187,7 @@ class SymExecWrapper:
             sf = sym_run(sf, env, self.corpus, spec, limits, max_steps=max_steps)
             # err_code is zeroed by between_txs, so every nonzero code here
             # is a loss from THIS transaction
-            err_code = np.asarray(sf.base.err_code)
-            trap_counts = {}
-            for code, name in TRAP_NAMES.items():
-                n = int((err_code == code).sum())
-                if n:
-                    trap_counts[name] = n
+            trap_counts = _count_traps(np.asarray(sf.base.err_code))
             self.tx_contexts.append(AnalysisContext(
                 sf=sf, corpus=self.corpus, limits=limits,
                 contract_names=names, solver_iters=solver_iters,

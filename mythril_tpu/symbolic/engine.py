@@ -801,8 +801,11 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         # snapshot consumed them already)
         sym_jump_dest=jnp.where(go, 0, sf.sym_jump_dest),
         sym_jump_pc=jnp.where(go, -1, sf.sym_jump_pc),
-        n_calls=jnp.where(go, 0, sf.n_calls),
-        n_mut_calls=jnp.where(go, 0, sf.n_mut_calls),
+        # the saturation counters reset for EVERY lane (not just survivors):
+        # coverage_summary sums them across tx snapshots, and a retired
+        # lane's stale count would be recounted each remaining tx
+        n_calls=jnp.zeros_like(sf.n_calls),
+        n_mut_calls=jnp.zeros_like(sf.n_mut_calls),
         call_op=jnp.where(go[:, None], 0, sf.call_op),
         call_to=jnp.where(go[:, None, None], 0, sf.call_to),
         call_to_sym=jnp.where(go[:, None], 0, sf.call_to_sym),
@@ -815,7 +818,7 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         arb_key_node=jnp.where(go, 0, sf.arb_key_node),
         arb_key_pc=jnp.where(go, -1, sf.arb_key_pc),
         dropped_forks=jnp.zeros_like(sf.dropped_forks),
-        n_arith=jnp.where(go, 0, sf.n_arith),
+        n_arith=jnp.zeros_like(sf.n_arith),
         arith_op=jnp.where(go[:, None], 0, sf.arith_op),
         arith_a=jnp.where(go[:, None], 0, sf.arith_a),
         arith_b=jnp.where(go[:, None], 0, sf.arith_b),

@@ -237,7 +237,10 @@ def propagate_feasibility(sf: SymFrontier):
 def kill_infeasible(sf: SymFrontier) -> SymFrontier:
     """Deactivate lanes whose path condition is provably unsatisfiable."""
     _, _, inf = propagate_feasibility(sf)
-    inf = inf & sf.base.active
+    # errored lanes stay resident (not recycled) until the tx boundary so
+    # their err_code survives for the per-tx trap tally; they are also not
+    # "kills" — the trap already accounts for them
+    inf = inf & sf.base.active & ~sf.base.error
     return sf.replace(
         base=sf.base.replace(active=sf.base.active & ~inf),
         killed_infeasible=sf.killed_infeasible | inf,
