@@ -1,12 +1,11 @@
 """RequirementsViolation (SWC-123): a call into another contract violates
-that callee's requirements (Error(string) revert in a sub-frame).
+that callee's requirements (revert in a sub-frame).
 
 Reference: ``mythril/analysis/module/modules/requirements_violation.py``
-(⚠unv). This module needs sub-transaction frames to observe a CALLEE's
-revert; until the inter-contract call layer lands (BASELINE config 4),
-external calls are summarized by symbolic RETVALs and no sub-frame revert
-payloads exist — the scan below activates automatically once the tx layer
-records callee frames with Error(string) payloads.
+(⚠unv). The sub-transaction layer records the pc of the first CALL whose
+callee frame reverted/failed in ``sub_revert_pc``
+(``symbolic/engine.py:pop_frames``); a lane carrying that event witnessed
+a violated callee requirement reachable from attacker inputs.
 """
 
 from __future__ import annotations
@@ -32,25 +31,11 @@ class RequirementsViolation(DetectionModule):
 
     def _execute(self, ctx) -> List[Issue]:
         issues: List[Issue] = []
-        # sub-call frames: recorded by the transaction layer as lanes whose
-        # tx depth > 0; absent that metadata, there is nothing to scan
-        depth = getattr(ctx.sf, "tx_depth", None)
-        if depth is None:
-            return issues
-        reverted = np.asarray(ctx.sf.base.reverted)
-        retval = np.asarray(ctx.sf.base.retval)
-        retval_len = np.asarray(ctx.sf.base.retval_len)
-        pcs = np.asarray(ctx.sf.base.pc)
-        depth = np.asarray(depth)
+        sub_pc = np.asarray(ctx.sf.sub_revert_pc)
         for lane in ctx.lanes(include_reverted=True):
-            if int(depth[lane]) == 0 or not bool(reverted[lane]):
+            pc = int(sub_pc[lane])
+            if pc < 0:
                 continue
-            if int(retval_len[lane]) < 4:
-                continue
-            payload = bytes(retval[lane, :4])
-            if payload != ERROR_SELECTOR:
-                continue
-            pc = int(pcs[lane])
             cid = ctx.contract_of(lane)
             if self._seen(cid, pc):
                 continue

@@ -175,7 +175,8 @@ class SymExecWrapper:
         contract_id = np.repeat(np.arange(C, dtype=np.int32), lanes_per_contract)
         active = np.zeros(P, dtype=bool)
         active[::lanes_per_contract] = True  # one seed lane per contract
-        sf = make_sym_frontier(P, limits, contract_id=contract_id, active=active)
+        sf = make_sym_frontier(P, limits, contract_id=contract_id, active=active,
+                               n_contracts=C)
         env = make_env(P)
         names = list(contract_names or [f"contract_{i}" for i in range(C)])
 

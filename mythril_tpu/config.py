@@ -23,6 +23,7 @@ class LimitsConfig:
     calldata_bytes: int = 256  # symbolic tx calldata cap
     returndata_bytes: int = 256
     storage_slots: int = 64  # associative storage-cache entries per lane
+    max_accounts: int = 8  # per-lane world-state account slots
     max_code: int = 24576  # EIP-170 runtime-code limit
     max_hash_bytes: int = 200  # SHA3 input cap (mapping keys are 64 bytes)
     log_slots: int = 8  # recorded LOG entries per lane
@@ -47,6 +48,7 @@ TEST_LIMITS = LimitsConfig(
     calldata_bytes=128,
     returndata_bytes=128,
     storage_slots=16,
+    max_accounts=4,
     max_code=512,
     max_hash_bytes=136,
     log_slots=4,

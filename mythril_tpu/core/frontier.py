@@ -16,7 +16,7 @@ multi-tx layer.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 import jax.numpy as jnp
@@ -44,6 +44,8 @@ class Trap:
     OOG = 7              # out of gas
     TAPE_LIMIT = 8       # symbolic tape full
     CONSTRAINT_LIMIT = 9  # path-condition slots full
+    STATIC_WRITE = 10    # state modification inside a STATICCALL frame
+    ACCOUNTS_FULL = 11   # world-state account table full
 
 
 TRAP_NAMES = {
@@ -56,12 +58,32 @@ TRAP_NAMES = {
     Trap.OOG: "out_of_gas",
     Trap.TAPE_LIMIT: "tape_cap",
     Trap.CONSTRAINT_LIMIT: "constraint_cap",
+    Trap.STATIC_WRITE: "static_write",
+    Trap.ACCOUNTS_FULL: "accounts_cap",
 }
 
 # trap codes that are capacity artifacts of this engine (coverage loss)
 # rather than genuine EVM exceptional halts
 CAP_TRAPS = (Trap.STACK, Trap.OOB_MEM, Trap.STORAGE_SLOTS, Trap.HASH_LIMIT,
-             Trap.TAPE_LIMIT, Trap.CONSTRAINT_LIMIT)
+             Trap.TAPE_LIMIT, Trap.CONSTRAINT_LIMIT, Trap.ACCOUNTS_FULL)
+
+
+# Reference's well-known actors (mythril/laser/ethereum/transaction ⚠unv).
+ATTACKER_ADDRESS = 0xDEADBEEFDEADBEEFDEADBEEFDEADBEEFDEADBEEF
+CREATOR_ADDRESS = 0xAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFE
+
+# account-table slot convention (uniform across lanes so host fixtures can
+# address slots without per-lane maps): 0 = attacker EOA, 1 = creator EOA,
+# 2+i = corpus contract i (when the corpus fits max_accounts; otherwise
+# slot 2 holds the lane's own contract only)
+ACCT_ATTACKER = 0
+ACCT_CREATOR = 1
+ACCT_CONTRACT0 = 2
+
+
+def contract_address(i: int) -> int:
+    """Deterministic default address of corpus contract i."""
+    return 0xAFFE + 0x10000 * i
 
 
 @struct.dataclass
@@ -73,7 +95,50 @@ class Frontier:
     err_code: jnp.ndarray  # i32[P] first Trap cause (0 = none)
     reverted: jnp.ndarray  # bool[P] halted via REVERT
     pc: jnp.ndarray  # i32[P]
-    contract_id: jnp.ndarray  # i32[P] index into Corpus arrays
+    contract_id: jnp.ndarray  # i32[P] index into Corpus arrays (code to run)
+    # --- call-frame context (reference: GlobalState.environment + tx_stack
+    # depth ⚠unv; sub-frames share the stack array via sp_base) ---
+    depth: jnp.ndarray  # i32[P] current call depth (0 = top frame)
+    sp_base: jnp.ndarray  # i32[P] first stack slot owned by this frame
+    static: jnp.ndarray  # bool[P] STATICCALL context (writes trap)
+    cur_acct: jnp.ndarray  # i32[P] account slot whose storage/balance we use
+    home_acct: jnp.ndarray  # i32[P] the lane's own contract account (tx reset)
+    home_contract: jnp.ndarray  # i32[P] the lane's own corpus index (tx reset)
+    caller_addr: jnp.ndarray  # u32[P, 8] msg.sender of this frame
+    callvalue: jnp.ndarray  # u32[P, 8] msg.value of this frame
+    pc_hold: jnp.ndarray  # bool[P] transient: handler set pc; epilogue must
+    # not advance it this step (cleared by epilogue)
+    # --- saved caller frames (reference: the Python call stack through
+    # Instruction.call_ + tx_stack ⚠unv; here explicit save/restore arrays
+    # indexed by depth; the stack array itself is shared via sp_base) ---
+    fr_ret_pc: jnp.ndarray  # i32[P, D] pc of the CALL instruction
+    fr_sp: jnp.ndarray  # i32[P, D] caller sp after popping the call args
+    fr_sp_base: jnp.ndarray  # i32[P, D]
+    fr_static: jnp.ndarray  # bool[P, D]
+    fr_cur_acct: jnp.ndarray  # i32[P, D]
+    fr_contract_id: jnp.ndarray  # i32[P, D]
+    fr_caller_addr: jnp.ndarray  # u32[P, D, 8]
+    fr_callvalue: jnp.ndarray  # u32[P, D, 8]
+    fr_memory: jnp.ndarray  # u8[P, D, M]
+    fr_mem_words: jnp.ndarray  # i32[P, D]
+    fr_calldata: jnp.ndarray  # u8[P, D, CD]
+    fr_calldata_len: jnp.ndarray  # i32[P, D]
+    fr_ret_off: jnp.ndarray  # i64[P, D] caller's returndata destination
+    fr_ret_len: jnp.ndarray  # i64[P, D]
+    fr_gas_min: jnp.ndarray  # i64[P, D] gas snapshot (restored on failure:
+    fr_gas_max: jnp.ndarray  # i64[P, D]  no 63/64 forwarding model)
+    # storage + balance snapshots for sub-frame revert rollback
+    fr_st_keys: jnp.ndarray  # u32[P, D, K, 8]
+    fr_st_vals: jnp.ndarray  # u32[P, D, K, 8]
+    fr_st_used: jnp.ndarray  # bool[P, D, K]
+    fr_st_written: jnp.ndarray  # bool[P, D, K]
+    fr_st_acct: jnp.ndarray  # i32[P, D, K]
+    fr_acct_bal: jnp.ndarray  # u32[P, D, A, 8]
+    # --- per-lane world state (reference: WorldState/Account ⚠unv) ---
+    acct_addr: jnp.ndarray  # u32[P, A, 8]
+    acct_code: jnp.ndarray  # i32[P, A] corpus index (-1 = EOA / no code)
+    acct_bal: jnp.ndarray  # u32[P, A, 8]
+    acct_used: jnp.ndarray  # bool[P, A]
     # --- stack ---
     stack: jnp.ndarray  # u32[P, S, 8]
     sp: jnp.ndarray  # i32[P] number of occupied slots
@@ -89,6 +154,7 @@ class Frontier:
     st_vals: jnp.ndarray  # u32[P, K, 8]
     st_used: jnp.ndarray  # bool[P, K]
     st_written: jnp.ndarray  # bool[P, K] written (vs merely loaded) this tx
+    st_acct: jnp.ndarray  # i32[P, K] account slot owning the entry
     # --- calldata / returndata ---
     calldata: jnp.ndarray  # u8[P, CD]
     calldata_len: jnp.ndarray  # i32[P]
@@ -120,18 +186,41 @@ class Frontier:
             err_code=jnp.where(mask & (self.err_code == 0), code, self.err_code),
         )
 
+    # --- world-state helpers ---
+
+    def acct_field(self, arr, slot) -> jnp.ndarray:
+        """Per-lane gather arr[P, A, ...] at account slot[P]."""
+        idx = jnp.clip(slot, 0, arr.shape[1] - 1).astype(jnp.int32)
+        if arr.ndim == 3:
+            return jnp.take_along_axis(arr, idx[:, None, None], axis=1)[:, 0]
+        return jnp.take_along_axis(arr, idx[:, None], axis=1)[:, 0]
+
+    @property
+    def self_address(self) -> jnp.ndarray:
+        return self.acct_field(self.acct_addr, self.cur_acct)
+
+    @property
+    def self_balance(self) -> jnp.ndarray:
+        return self.acct_field(self.acct_bal, self.cur_acct)
+
+    def acct_lookup(self, addr) -> tuple:
+        """(found bool[P], slot i32[P]) of the account holding ``addr``."""
+        match = self.acct_used & jnp.all(
+            self.acct_addr == addr[:, None, :], axis=-1
+        )
+        return jnp.any(match, axis=1), jnp.argmax(match, axis=1).astype(jnp.int32)
+
 
 @struct.dataclass
 class Env:
-    """Per-lane execution environment (reference: ``Environment`` +
-    block info from ``GlobalState`` ⚠unv). u256 limb arrays [P, 8]."""
+    """Tx-global execution environment (reference: block info on
+    ``GlobalState`` ⚠unv). Frame-scoped values (address, caller,
+    callvalue, balances) live on the :class:`Frontier` so sub-call frames
+    can swap them; only what is constant across a transaction stays here.
+    u256 limb arrays [P, 8]."""
 
-    address: jnp.ndarray
-    caller: jnp.ndarray
     origin: jnp.ndarray
-    callvalue: jnp.ndarray
     gasprice: jnp.ndarray
-    balance: jnp.ndarray  # balance of `address` (world-state integration later)
     coinbase: jnp.ndarray
     timestamp: jnp.ndarray
     number: jnp.ndarray
@@ -166,12 +255,28 @@ def make_frontier(
     calldata_len=None,
     gas_limit: int = 10_000_000,
     active=None,
+    n_contracts: int = 1,
+    contract_addrs: Optional[Sequence[int]] = None,
+    caller: int = ATTACKER_ADDRESS,
+    callvalue: int = 0,
+    balance: int = 10**18,
+    attacker_balance: int = 10**20,
 ) -> Frontier:
+    """Fresh frontier with a seeded per-lane world state.
+
+    Account layout (see slot-convention constants above): attacker and
+    creator EOAs, then the corpus contracts — every lane gets the same
+    table when ``2 + n_contracts <= max_accounts``; otherwise each lane
+    registers only its own contract at slot 2. The executing account
+    (``cur_acct``) is the lane's own contract.
+    """
     P = n_lanes
     L = limits
+    A = L.max_accounts
     z8 = lambda *s: jnp.zeros(s + (8,), dtype=jnp.uint32)
     if contract_id is None:
         contract_id = jnp.zeros(P, dtype=jnp.int32)
+    contract_id = jnp.asarray(contract_id, dtype=jnp.int32)
     if calldata is None:
         calldata = jnp.zeros((P, L.calldata_bytes), dtype=jnp.uint8)
     else:
@@ -181,6 +286,43 @@ def make_frontier(
         calldata_len = jnp.zeros(P, dtype=jnp.int32)
     if active is None:
         active = jnp.ones(P, dtype=bool)
+
+    if contract_addrs is None:
+        contract_addrs = [contract_address(i) for i in range(n_contracts)]
+    C = len(contract_addrs)
+
+    # account table (numpy host build, then broadcast / scatter)
+    addr = np.zeros((P, A, 8), dtype=np.uint32)
+    code = np.full((P, A), -1, dtype=np.int32)
+    bal = np.zeros((P, A, 8), dtype=np.uint32)
+    used = np.zeros((P, A), dtype=bool)
+    addr[:, ACCT_ATTACKER] = u256.from_int(ATTACKER_ADDRESS)
+    bal[:, ACCT_ATTACKER] = u256.from_int(attacker_balance)
+    used[:, ACCT_ATTACKER] = True
+    addr[:, ACCT_CREATOR] = u256.from_int(CREATOR_ADDRESS)
+    bal[:, ACCT_CREATOR] = u256.from_int(attacker_balance)
+    used[:, ACCT_CREATOR] = True
+    cid_np = np.asarray(contract_id)
+    if ACCT_CONTRACT0 + C <= A:
+        for i, a in enumerate(contract_addrs):
+            addr[:, ACCT_CONTRACT0 + i] = u256.from_int(a)
+            code[:, ACCT_CONTRACT0 + i] = i
+            bal[:, ACCT_CONTRACT0 + i] = u256.from_int(balance)
+            used[:, ACCT_CONTRACT0 + i] = True
+        cur_acct = ACCT_CONTRACT0 + cid_np
+    else:
+        for lane in range(P):
+            i = int(cid_np[lane]) if cid_np.ndim else int(cid_np)
+            addr[lane, ACCT_CONTRACT0] = u256.from_int(contract_addrs[i])
+            code[lane, ACCT_CONTRACT0] = i
+            bal[lane, ACCT_CONTRACT0] = u256.from_int(balance)
+            used[lane, ACCT_CONTRACT0] = True
+        cur_acct = np.full(P, ACCT_CONTRACT0, dtype=np.int32)
+
+    def w(v: int):
+        return jnp.broadcast_to(jnp.asarray(u256.from_int(v)), (P, 8))
+
+    D = L.call_depth
     return Frontier(
         active=active,
         halted=jnp.zeros(P, dtype=bool),
@@ -188,7 +330,42 @@ def make_frontier(
         err_code=jnp.zeros(P, dtype=jnp.int32),
         reverted=jnp.zeros(P, dtype=bool),
         pc=jnp.zeros(P, dtype=jnp.int32),
-        contract_id=jnp.asarray(contract_id, dtype=jnp.int32),
+        contract_id=contract_id,
+        depth=jnp.zeros(P, dtype=jnp.int32),
+        sp_base=jnp.zeros(P, dtype=jnp.int32),
+        static=jnp.zeros(P, dtype=bool),
+        cur_acct=jnp.asarray(cur_acct, dtype=jnp.int32),
+        home_acct=jnp.asarray(cur_acct, dtype=jnp.int32),
+        home_contract=contract_id,
+        caller_addr=w(caller),
+        callvalue=w(callvalue),
+        pc_hold=jnp.zeros(P, dtype=bool),
+        fr_ret_pc=jnp.zeros((P, D), dtype=jnp.int32),
+        fr_sp=jnp.zeros((P, D), dtype=jnp.int32),
+        fr_sp_base=jnp.zeros((P, D), dtype=jnp.int32),
+        fr_static=jnp.zeros((P, D), dtype=bool),
+        fr_cur_acct=jnp.zeros((P, D), dtype=jnp.int32),
+        fr_contract_id=jnp.zeros((P, D), dtype=jnp.int32),
+        fr_caller_addr=z8(P, D),
+        fr_callvalue=z8(P, D),
+        fr_memory=jnp.zeros((P, D, L.mem_bytes), dtype=jnp.uint8),
+        fr_mem_words=jnp.zeros((P, D), dtype=jnp.int32),
+        fr_calldata=jnp.zeros((P, D, L.calldata_bytes), dtype=jnp.uint8),
+        fr_calldata_len=jnp.zeros((P, D), dtype=jnp.int32),
+        fr_ret_off=jnp.zeros((P, D), dtype=jnp.int64),
+        fr_ret_len=jnp.zeros((P, D), dtype=jnp.int64),
+        fr_gas_min=jnp.zeros((P, D), dtype=jnp.int64),
+        fr_gas_max=jnp.zeros((P, D), dtype=jnp.int64),
+        fr_st_keys=z8(P, D, L.storage_slots),
+        fr_st_vals=z8(P, D, L.storage_slots),
+        fr_st_used=jnp.zeros((P, D, L.storage_slots), dtype=bool),
+        fr_st_written=jnp.zeros((P, D, L.storage_slots), dtype=bool),
+        fr_st_acct=jnp.zeros((P, D, L.storage_slots), dtype=jnp.int32),
+        fr_acct_bal=z8(P, D, A),
+        acct_addr=jnp.asarray(addr),
+        acct_code=jnp.asarray(code),
+        acct_bal=jnp.asarray(bal),
+        acct_used=jnp.asarray(used),
         stack=z8(P, L.max_stack),
         sp=jnp.zeros(P, dtype=jnp.int32),
         memory=jnp.zeros((P, L.mem_bytes), dtype=jnp.uint8),
@@ -200,6 +377,7 @@ def make_frontier(
         st_vals=z8(P, L.storage_slots),
         st_used=jnp.zeros((P, L.storage_slots), dtype=bool),
         st_written=jnp.zeros((P, L.storage_slots), dtype=bool),
+        st_acct=jnp.zeros((P, L.storage_slots), dtype=jnp.int32),
         calldata=calldata,
         calldata_len=jnp.asarray(calldata_len, dtype=jnp.int32),
         returndata=jnp.zeros((P, L.returndata_bytes), dtype=jnp.uint8),
@@ -213,11 +391,7 @@ def make_frontier(
 
 def make_env(
     n_lanes: int,
-    address: int = 0xAFFE,
-    caller: int = 0xDEADBEEF,
-    origin: Optional[int] = None,
-    callvalue: int = 0,
-    balance: int = 10**18,
+    origin: int = ATTACKER_ADDRESS,
     timestamp: int = 1_700_000_000,
     number: int = 17_000_000,
     chainid: int = 1,
@@ -228,12 +402,8 @@ def make_env(
         return jnp.broadcast_to(jnp.asarray(u256.from_int(v)), (P, 8))
 
     return Env(
-        address=w(address),
-        caller=w(caller),
-        origin=w(origin if origin is not None else caller),
-        callvalue=w(callvalue),
+        origin=w(origin),
         gasprice=w(10**9),
-        balance=w(balance),
         coinbase=w(0xC01BA5E),
         timestamp=w(timestamp),
         number=w(number),

@@ -344,22 +344,29 @@ def _h_env(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     cd_load = _be_bytes_to_word(
         _gather_bytes(f.calldata, u256.to_u64_saturating(a).astype(I64), 32, f.calldata_len)
     )
-    self_addr = env.address
-    bal_query_self = u256.eq(a, self_addr)
-    balance_val = jnp.where(bal_query_self[:, None], env.balance, 0).astype(U32)
+    self_addr = f.self_address
+    # BALANCE / EXTCODESIZE answered from the per-lane account table;
+    # unknown addresses read 0 concretely (the symbolic layer havocs them)
+    found, slot = f.acct_lookup(a)
+    acct_bal = f.acct_field(f.acct_bal, slot)
+    balance_val = jnp.where(found[:, None], acct_bal, 0).astype(U32)
+    ext_code = f.acct_field(f.acct_code, slot)
+    ext_len = jnp.where(
+        found & (ext_code >= 0),
+        corpus.code_len[jnp.clip(ext_code, 0, corpus.code_len.shape[0] - 1)],
+        0,
+    )
+    extsize = u256.from_u64_scalar(ext_len.astype(jnp.uint64))
 
-    r = env.address
+    r = self_addr
     r = jnp.where((op == 0x31)[:, None], balance_val, r)
     r = jnp.where((op == 0x32)[:, None], env.origin, r)
-    r = jnp.where((op == 0x33)[:, None], env.caller, r)
-    r = jnp.where((op == 0x34)[:, None], env.callvalue, r)
+    r = jnp.where((op == 0x33)[:, None], f.caller_addr, r)
+    r = jnp.where((op == 0x34)[:, None], f.callvalue, r)
     r = jnp.where((op == 0x35)[:, None], cd_load, r)
     r = jnp.where((op == 0x36)[:, None], u256.from_u64_scalar(f.calldata_len.astype(jnp.uint64)), r)
     r = jnp.where((op == 0x38)[:, None], u256.from_u64_scalar(code_len.astype(jnp.uint64)), r)
     r = jnp.where((op == 0x3A)[:, None], env.gasprice, r)
-    # EXTCODESIZE/EXTCODEHASH: world-state integration later; self-query answered
-    ext_self = u256.eq(a, self_addr)
-    extsize = jnp.where(ext_self[:, None], u256.from_u64_scalar(code_len.astype(jnp.uint64)), 0).astype(U32)
     r = jnp.where((op == 0x3B)[:, None], extsize, r)
     r = jnp.where((op == 0x3D)[:, None], u256.from_u64_scalar(f.returndata_len.astype(jnp.uint64)), r)
     r = jnp.where((op == 0x3F)[:, None], jnp.zeros_like(r), r)  # EXTCODEHASH stub
@@ -370,7 +377,7 @@ def _h_env(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     r = jnp.where((op == 0x44)[:, None], env.prevrandao, r)
     r = jnp.where((op == 0x45)[:, None], env.blk_gaslimit, r)
     r = jnp.where((op == 0x46)[:, None], env.chainid, r)
-    r = jnp.where((op == 0x47)[:, None], env.balance, r)
+    r = jnp.where((op == 0x47)[:, None], f.self_balance, r)
     r = jnp.where((op == 0x48)[:, None], env.basefee, r)
 
     sin = _J_STACK_IN[op]
@@ -402,9 +409,22 @@ def _h_copy(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     code_row = corpus.code[f.contract_id]
     code = _take_per_lane(code_row, sidx, corpus.code_len[f.contract_id].astype(I64))
     rd = _take_per_lane(f.returndata, sidx, f.returndata_len.astype(I64))
+    # EXTCODECOPY: resolve the address against the account table; unknown
+    # or codeless accounts copy zeros (EVM: empty code)
+    found, slot = f.acct_lookup(_peek(f, 0))
+    ext_cid = f.acct_field(f.acct_code, slot)
+    have_ext = found & (ext_cid >= 0)
+    ext_row = corpus.code[jnp.clip(ext_cid, 0, corpus.code.shape[0] - 1)]
+    ext_limit = jnp.where(
+        have_ext,
+        corpus.code_len[jnp.clip(ext_cid, 0, corpus.code_len.shape[0] - 1)],
+        0,
+    )
+    ext = _take_per_lane(ext_row, sidx, ext_limit.astype(I64))
     srcb = jnp.where((op == 0x37)[:, None], cd,
                      jnp.where((op == 0x39)[:, None], code,
-                               jnp.where((op == 0x3E)[:, None], rd, 0)))  # EXTCODECOPY -> zeros
+                               jnp.where((op == 0x3E)[:, None], rd,
+                                         jnp.where((op == 0x3C)[:, None], ext, 0))))
     memory = jnp.where(in_window & ok[:, None], srcb, f.memory)
     words = (ln64 + 31) // 32
     f = _charge(f, ok, 3 * words)
@@ -447,8 +467,14 @@ def _h_mem(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
 
 
 def _storage_lookup(f: Frontier, key):
-    """(hit bool[P], value u32[P,8], hit_slot i32[P])"""
-    match = f.st_used & jnp.all(f.st_keys == key[:, None, :], axis=-1)  # [P,K]
+    """(hit bool[P], value u32[P,8], hit_slot i32[P]) — scoped to the
+    executing account (``cur_acct``), so cross-contract frames see their
+    own storage (reference: ``Account.storage`` per account ⚠unv)."""
+    match = (
+        f.st_used
+        & (f.st_acct == f.cur_acct[:, None])
+        & jnp.all(f.st_keys == key[:, None, :], axis=-1)
+    )  # [P,K]
     hit = jnp.any(match, axis=1)
     slot = jnp.argmax(match, axis=1).astype(I32)
     val = jnp.sum(jnp.where(match[:, :, None], f.st_vals, 0), axis=1).astype(U32)
@@ -487,6 +513,8 @@ def _h_storage(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     key = _peek(f, 0)
     val = _peek(f, 1)
     is_store = op == 0x55
+    static_viol = m & is_store & f.static
+    m = m & ~static_viol
     hit, cur, slot = _storage_lookup(f, key)
 
     # SLOAD: miss -> 0 (clean storage; unconstrained/world storage in sym layer)
@@ -498,12 +526,13 @@ def _h_storage(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     st_vals = jnp.where(onehot[:, :, None], val[:, None, :], f.st_vals)
     st_used = f.st_used | onehot
     st_written = f.st_written | onehot
+    st_acct = jnp.where(onehot, f.cur_acct[:, None], f.st_acct)
 
     sp = jnp.where(m & is_store, f.sp - 2, f.sp)
     return f.replace(
         stack=stack, sp=sp, st_keys=st_keys, st_vals=st_vals,
-        st_used=st_used, st_written=st_written,
-    ).trap(overflow, Trap.STORAGE_SLOTS)
+        st_used=st_used, st_written=st_written, st_acct=st_acct,
+    ).trap(overflow, Trap.STORAGE_SLOTS).trap(static_viol, Trap.STATIC_WRITE)
 
 
 def _h_jump(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -524,6 +553,8 @@ def _h_halt(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     is_revert = op == 0xFD
     is_invalid = op == 0xFE
     is_sd = op == 0xFF
+    static_viol = m & is_sd & f.static
+    m = m & ~static_viol
     has_data = is_return | is_revert
 
     off = u256.to_u64_saturating(_peek(f, 0)).astype(I64)
@@ -541,7 +572,9 @@ def _h_halt(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     gas_min = jnp.where(m & is_invalid, f.gas_limit, f.gas_min)
     gas_max = jnp.where(m & is_invalid, f.gas_limit, f.gas_max)
 
-    return f.trap(m & is_invalid, Trap.INVALID_OP).replace(
+    return f.trap(m & is_invalid, Trap.INVALID_OP).trap(
+        static_viol, Trap.STATIC_WRITE
+    ).replace(
         halted=f.halted | (m & ~is_invalid),
         reverted=f.reverted | (m & is_revert),
         selfdestructed=f.selfdestructed | (m & is_sd),
@@ -554,6 +587,8 @@ def _h_halt(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
 
 
 def _h_log(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
+    static_viol = m & f.static
+    m = m & ~static_viol
     off = u256.to_u64_saturating(_peek(f, 0)).astype(I64)
     ln = u256.to_u64_saturating(_peek(f, 1)).astype(I64)
     f, _ = _expand_memory(f, m & (ln > 0), off + ln)
@@ -561,7 +596,7 @@ def _h_log(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     return f.replace(
         n_logs=jnp.where(m, f.n_logs + 1, f.n_logs),
         sp=jnp.where(m, f.sp - _J_STACK_IN[op], f.sp),
-    )
+    ).trap(static_viol, Trap.STATIC_WRITE)
 
 
 def _h_call(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -621,8 +656,10 @@ def prologue(f: Frontier, corpus: Corpus):
     sin = _J_STACK_IN[op]
     sout = _J_STACK_OUT[op]
     invalid = running & ~_J_IS_VALID[op]
+    # arity is checked against the CURRENT frame's stack region: sub-call
+    # frames own [sp_base, sp) of the shared stack array
     stack_bad = running & _J_IS_VALID[op] & (
-        (f.sp < sin) | (f.sp - sin + sout > f.max_stack)
+        (f.sp - f.sp_base < sin) | (f.sp - sin + sout > f.max_stack)
     )
     f = f.trap(invalid, Trap.INVALID_OP).trap(stack_bad, Trap.STACK)
     run = running & ~invalid & ~stack_bad
@@ -653,11 +690,17 @@ def dispatch(f: Frontier, env: Env, corpus: Corpus, op, run, old_pc,
 
 
 def epilogue(f: Frontier, op, run, old_pc) -> Frontier:
-    """Default pc advance + out-of-gas trap after the handlers ran."""
+    """Default pc advance + out-of-gas trap after the handlers ran.
+    Lanes with ``pc_hold`` set (a handler placed pc explicitly — e.g. a
+    sub-call frame push pointing at the callee's entry) are left alone;
+    the flag is consumed here."""
     cls = _J_CLASS[op]
-    advanced = run & (cls != CLS_JUMP) & ~f.halted & ~f.error
+    advanced = run & (cls != CLS_JUMP) & ~f.halted & ~f.error & ~f.pc_hold
     next_pc = old_pc + 1 + _J_PUSH_WIDTH[op]
-    f = f.replace(pc=jnp.where(advanced, next_pc, f.pc))
+    f = f.replace(
+        pc=jnp.where(advanced, next_pc, f.pc),
+        pc_hold=jnp.zeros_like(f.pc_hold),
+    )
     oog = run & (f.gas_min > f.gas_limit)
     return f.trap(oog, Trap.OOG)
 

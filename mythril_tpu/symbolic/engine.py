@@ -31,7 +31,8 @@ from jax import lax
 
 from ..config import LimitsConfig, DEFAULT_LIMITS
 from ..core import interpreter as ci
-from ..core.frontier import Frontier, Env, Corpus, Trap
+from ..core.frontier import (Frontier, Env, Corpus, Trap, CAP_TRAPS,
+                             ATTACKER_ADDRESS)
 from ..ops import u256
 from .ops import SymOp, FreeKind, TX_STRIDE
 from .state import SymFrontier, SymSpec
@@ -184,22 +185,31 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     val = ci._peek(f, 1)
     val_sym = _peek_sym(sf, 1)
     is_store = op == 0x55
+    static_viol = m & is_store & f.static
+    m = m & ~static_viol
+    sf = sf.replace(base=f.trap(static_viol, Trap.STATIC_WRITE))
+    f = sf.base
 
+    in_acct = f.st_acct == f.cur_acct[:, None]
     conc = (key_sym[:, None] == 0) & (sf.st_key_sym == 0) & jnp.all(
         f.st_keys == key[:, None, :], axis=-1
     )
     symm = (key_sym[:, None] != 0) & (sf.st_key_sym == key_sym[:, None])
-    match = f.st_used & (conc | symm)
+    match = f.st_used & in_acct & (conc | symm)
     hit = jnp.any(match, axis=1)
     cur = jnp.sum(jnp.where(match[:, :, None], f.st_vals, 0), axis=1).astype(U32)
     cur_sym = jnp.sum(jnp.where(match, sf.st_val_sym, 0), axis=1).astype(I32)
 
-    # SLOAD miss -> fresh STORAGE leaf (hash-consed on key, so repeated
-    # loads of the same key agree); concrete-zero when storage isn't symbolic
+    # SLOAD miss -> fresh STORAGE leaf (hash-consed on (account, key), so
+    # repeated loads of the same key agree while distinct accounts'
+    # identical keys stay independent); concrete-zero when storage isn't
+    # symbolic. b encodes key_sym * A + account slot.
     miss_load = m & ~is_store & ~hit
+    A = f.acct_used.shape[1]
     if spec.storage:
         sf, leaf = append_node(
-            sf, miss_load, int(SymOp.FREE), int(FreeKind.STORAGE), key_sym,
+            sf, miss_load, int(SymOp.FREE), int(FreeKind.STORAGE),
+            key_sym * A + f.cur_acct,
             jnp.where((key_sym == 0)[:, None], key, 0).astype(U32),
         )
     else:
@@ -235,6 +245,7 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
             st_vals=jnp.where(onehot[:, :, None], val[:, None, :], f.st_vals),
             st_used=f.st_used | onehot,
             st_written=f.st_written | onehot,
+            st_acct=jnp.where(onehot, f.cur_acct[:, None], f.st_acct),
         ).trap(overflow, Trap.STORAGE_SLOTS),
         stack_sym=stack_sym,
         st_key_sym=jnp.where(onehot, key_sym[:, None], sf.st_key_sym),
@@ -309,58 +320,420 @@ def _h_sym_jump(sf: SymFrontier, corpus: Corpus, op, m, old_pc, known, ksign) ->
     )
 
 
-def _h_sym_callish(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
-    """CALL family + CREATE/CREATE2: record the event for detection
-    modules, push a fresh symbolic return value (reference: ``call_``
-    raising TransactionStartSignal; sub-tx semantics arrive with the
-    transaction layer)."""
-    f = sf.base
-    is_create = (op == 0xF0) | (op == 0xF5)
-    has_value = (op == 0xF1) | (op == 0xF2)  # CALL, CALLCODE
-    sin = ci._J_STACK_IN[op]
+def _fr_set(arr, d, val, mask):
+    """arr[P, D, ...]; arr[lane, d[lane]] = val[lane] where mask."""
+    Dn = arr.shape[1]
+    sel = (jnp.arange(Dn)[None, :] == d[:, None]) & mask[:, None]
+    sel = sel.reshape(sel.shape + (1,) * (arr.ndim - 2))
+    return jnp.where(sel, jnp.expand_dims(val, 1), arr)
 
-    to = ci._peek(f, 1)
-    to_sym = _peek_sym(sf, 1)
-    v_call = ci._peek(f, 2)
-    v_call_sym = _peek_sym(sf, 2)
-    v_create = ci._peek(f, 0)
-    v_create_sym = _peek_sym(sf, 0)
-    value = jnp.where(is_create[:, None], v_create, jnp.where(has_value[:, None], v_call, 0)).astype(U32)
-    value_sym = jnp.where(is_create, v_create_sym, jnp.where(has_value, v_call_sym, 0))
-    to_rec = jnp.where(is_create[:, None], 0, to).astype(U32)
-    to_sym_rec = jnp.where(is_create, 0, to_sym)
 
-    # output region havoc (call writes returndata into memory)
-    out_len = jnp.where(has_value[:, None], ci._peek(f, 6), ci._peek(f, 5))
-    out_len_sym = jnp.where(has_value, _peek_sym(sf, 6), _peek_sym(sf, 5))
-    havoc_mem = m & ~is_create & ((out_len_sym != 0) | ~u256.is_zero(out_len))
+def _fr_get(arr, d):
+    """arr[P, D, ...] gathered at per-lane depth index d."""
+    idx = jnp.clip(d, 0, arr.shape[1] - 1).astype(I32)
+    idxe = idx.reshape((idx.shape[0],) + (1,) * (arr.ndim - 1))
+    return jnp.take_along_axis(arr, idxe, axis=1)[:, 0]
 
+
+def _record_call_event(sf: SymFrontier, m, op, old_pc, to, to_sym, value,
+                       value_sym) -> SymFrontier:
+    """Append to the bounded per-tx call log (detection-module feed)."""
     CL = sf.call_to.shape[1]
     onehot = _event_slot(sf.n_calls, m, CL)
-
-    sf, rv = append_node(sf, m, int(SymOp.FREE), int(FreeKind.RETVAL), sf.n_calls)
-    f = sf.base
-    dest_slot = f.sp - sin
-    zero_w = jnp.zeros_like(to)
     return sf.replace(
-        base=f.replace(
-            stack=ci._set_slot(f.stack, dest_slot, zero_w, m),
-            sp=jnp.where(m, f.sp - sin + 1, f.sp),
-            returndata_len=jnp.where(m, 0, f.returndata_len),
-        ),
-        stack_sym=_set_sym_slot(sf.stack_sym, dest_slot, rv, m),
-        mem_havoc=sf.mem_havoc | havoc_mem,
-        retdata_sym=sf.retdata_sym | (m & ~is_create),
         n_calls=sf.n_calls + m.astype(I32),
         n_mut_calls=sf.n_mut_calls + (
             m & ((op == 0xF1) | (op == 0xF2) | (op == 0xF4))
         ).astype(I32),
-        call_to=jnp.where(onehot[:, :, None], to_rec[:, None, :], sf.call_to),
-        call_to_sym=jnp.where(onehot, to_sym_rec[:, None], sf.call_to_sym),
+        call_to=jnp.where(onehot[:, :, None], to[:, None, :], sf.call_to),
+        call_to_sym=jnp.where(onehot, to_sym[:, None], sf.call_to_sym),
         call_value=jnp.where(onehot[:, :, None], value[:, None, :], sf.call_value),
         call_value_sym=jnp.where(onehot, value_sym[:, None], sf.call_value_sym),
         call_op=jnp.where(onehot, op[:, None], sf.call_op),
         call_pc=jnp.where(onehot, old_pc[:, None], sf.call_pc),
+    )
+
+
+def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
+                limits: LimitsConfig) -> SymFrontier:
+    """CALL / CALLCODE / DELEGATECALL / STATICCALL with real sub-frames.
+
+    Reference: ``call_`` raising TransactionStartSignal + ``call.py``'s
+    callee resolution (``mythril/laser/ethereum/{instructions,call}.py``
+    ⚠unv, SURVEY.md §3.2). Three outcomes per lane:
+
+    - **internal**: concrete callee resolving to a corpus account with
+      code, concrete arg/ret windows, concrete (or absent) value, depth
+      headroom → push a frame and start executing the callee at pc 0;
+    - **eoa**: concrete callee that is a known codeless account → value
+      transfer + success=1 (no code to run);
+    - **external** (everything else: symbolic callee, unknown address,
+      symbolic value/windows, depth exhausted): havoc the return value
+      and output memory — the round-1 over-approximation, now the
+      fallback instead of the only path.
+    """
+    f = sf.base
+    has_value = (op == 0xF1) | (op == 0xF2)  # CALL, CALLCODE
+    is_call = op == 0xF1
+    is_deleg = op == 0xF4
+    is_static_op = op == 0xFA
+    sin = ci._J_STACK_IN[op]
+    D = f.fr_ret_pc.shape[1]
+    CD = f.calldata.shape[1]
+    CDW = sf.cd_sym.shape[1]
+    M = f.memory.shape[1]
+
+    # --- operand fetch (gas, to, [value], argsOff, argsLen, retOff, retLen)
+    to = ci._peek(f, 1)
+    to_sym = _peek_sym(sf, 1)
+    value = jnp.where(has_value[:, None], ci._peek(f, 2), 0).astype(U32)
+    value_sym = jnp.where(has_value, _peek_sym(sf, 2), 0)
+    base_i = jnp.where(has_value, 3, 2)
+    a_off_w, a_off_s = ci._peek(f, base_i), _peek_sym(sf, base_i)
+    a_len_w, a_len_s = ci._peek(f, base_i + 1), _peek_sym(sf, base_i + 1)
+    r_off_w, r_off_s = ci._peek(f, base_i + 2), _peek_sym(sf, base_i + 2)
+    r_len_w, r_len_s = ci._peek(f, base_i + 3), _peek_sym(sf, base_i + 3)
+    a_off = u256.to_u64_saturating(a_off_w).astype(I64)
+    a_len = u256.to_u64_saturating(a_len_w).astype(I64)
+    r_off = u256.to_u64_saturating(r_off_w).astype(I64)
+    r_len = u256.to_u64_saturating(r_len_w).astype(I64)
+
+    # CALL with nonzero (possibly) value inside STATICCALL: exceptional halt
+    static_viol = m & is_call & f.static & (
+        (value_sym != 0) | ~u256.is_zero(value)
+    )
+    sf = sf.replace(base=f.trap(static_viol, Trap.STATIC_WRITE))
+    f = sf.base
+    m = m & ~static_viol
+
+    # --- classification
+    conc_windows = (a_off_s == 0) & (a_len_s == 0) & (r_off_s == 0) & (r_len_s == 0)
+    found, slot = f.acct_lookup(to)
+    callee_code = f.acct_field(f.acct_code, slot)
+    value_conc = value_sym == 0
+    resolvable = (
+        m & (to_sym == 0) & found & conc_windows & value_conc
+        & (f.depth < D) & (a_len <= CD)
+    )
+    internal = resolvable & (callee_code >= 0)
+    eoa = resolvable & (callee_code < 0)
+    external = m & ~internal & ~eoa
+
+    # memory expansion for the arg/ret windows (charged at call time)
+    f = sf.base
+    f, oob_a = ci._expand_memory(f, (internal | eoa) & (a_len > 0), a_off + a_len)
+    f, oob_r = ci._expand_memory(f, (internal | eoa) & (r_len > 0), r_off + r_len)
+    sf = sf.replace(base=f)
+    oob = oob_a | oob_r
+    internal = internal & ~oob
+    eoa = eoa & ~oob
+
+    # --- value transfer feasibility (concrete value; payer = executing acct)
+    payer_bal = f.self_balance
+    wants_value = has_value & ~u256.is_zero(value)
+    insufficient = (internal | eoa) & wants_value & u256.lt(payer_bal, value)
+    fail0 = insufficient  # push success=0, no frame, no transfer
+    internal_go = internal & ~insufficient
+    eoa_ok = eoa & ~insufficient
+    # CALLCODE sends value to self (net zero); only plain CALL moves funds
+    transfer = (internal_go | eoa_ok) & is_call & wants_value & (slot != f.cur_acct)
+    payee_bal = f.acct_field(f.acct_bal, slot)
+    payer_new = u256.sub(payer_bal, value)
+    payee_new = u256.add(payee_bal, value)
+    A = f.acct_used.shape[1]
+    payer_oh = (jnp.arange(A)[None, :] == f.cur_acct[:, None]) & transfer[:, None]
+    payee_oh = (jnp.arange(A)[None, :] == slot[:, None]) & transfer[:, None]
+    acct_bal = jnp.where(payer_oh[:, :, None], payer_new[:, None, :], f.acct_bal)
+    acct_bal = jnp.where(payee_oh[:, :, None], payee_new[:, None, :], acct_bal)
+    f = f.replace(acct_bal=acct_bal)
+    sf = sf.replace(base=f)
+
+    # --- event record for every path (modules consume this)
+    sf = _record_call_event(sf, m, op, old_pc, to.astype(U32), to_sym,
+                            value, value_sym)
+    f = sf.base
+
+    # --- external fallback: havoc retval + output region
+    havoc_mem = external & ((r_len_s != 0) | ~u256.is_zero(r_len_w))
+    sf, rv = append_node(sf, external, int(SymOp.FREE), int(FreeKind.RETVAL),
+                         jnp.maximum(sf.n_calls - 1, 0))
+    f = sf.base
+
+    # --- push the result word for the non-frame paths
+    dest_slot = f.sp - sin
+    m_push = external | eoa_ok | fail0
+    one_w = jnp.zeros_like(to).at[:, 0].set(1)
+    zero_w = jnp.zeros_like(to)
+    res_w = jnp.where(eoa_ok[:, None], one_w, zero_w).astype(U32)
+    stack = ci._set_slot(f.stack, dest_slot, res_w, m_push)
+    res_sym = jnp.where(external, rv, 0)
+    stack_sym = _set_sym_slot(sf.stack_sym, dest_slot, res_sym, m_push)
+
+    # --- frame push for internal calls
+    d = f.depth
+    mi = internal_go
+    f2 = f.replace(
+        fr_ret_pc=_fr_set(f.fr_ret_pc, d, old_pc, mi),
+        fr_sp=_fr_set(f.fr_sp, d, f.sp - sin, mi),
+        fr_sp_base=_fr_set(f.fr_sp_base, d, f.sp_base, mi),
+        fr_static=_fr_set(f.fr_static, d, f.static, mi),
+        fr_cur_acct=_fr_set(f.fr_cur_acct, d, f.cur_acct, mi),
+        fr_contract_id=_fr_set(f.fr_contract_id, d, f.contract_id, mi),
+        fr_caller_addr=_fr_set(f.fr_caller_addr, d, f.caller_addr, mi),
+        fr_callvalue=_fr_set(f.fr_callvalue, d, f.callvalue, mi),
+        fr_memory=_fr_set(f.fr_memory, d, f.memory, mi),
+        fr_mem_words=_fr_set(f.fr_mem_words, d, f.mem_words, mi),
+        fr_calldata=_fr_set(f.fr_calldata, d, f.calldata, mi),
+        fr_calldata_len=_fr_set(f.fr_calldata_len, d, f.calldata_len, mi),
+        fr_ret_off=_fr_set(f.fr_ret_off, d, r_off, mi),
+        fr_ret_len=_fr_set(f.fr_ret_len, d, r_len, mi),
+        fr_gas_min=_fr_set(f.fr_gas_min, d, f.gas_min, mi),
+        fr_gas_max=_fr_set(f.fr_gas_max, d, f.gas_max, mi),
+        fr_st_keys=_fr_set(f.fr_st_keys, d, f.st_keys, mi),
+        fr_st_vals=_fr_set(f.fr_st_vals, d, f.st_vals, mi),
+        fr_st_used=_fr_set(f.fr_st_used, d, f.st_used, mi),
+        fr_st_written=_fr_set(f.fr_st_written, d, f.st_written, mi),
+        fr_st_acct=_fr_set(f.fr_st_acct, d, f.st_acct, mi),
+        fr_acct_bal=_fr_set(f.fr_acct_bal, d, f.acct_bal, mi),
+    )
+
+    # callee calldata: bytes from the caller's memory window
+    callee_cd = ci._gather_bytes(f.memory, a_off, CD, jnp.full_like(a_off, M))
+    callee_cd = jnp.where(jnp.arange(CD)[None, :] < a_len[:, None], callee_cd, 0)
+    # per-word syms: aligned windows map caller mem_sym; a partially
+    # covered tail word or unaligned offset with symbolic content havocs
+    # the whole frame calldata (coarse, sound)
+    aligned_a = (a_off % 32) == 0
+    w0 = (a_off // 32).astype(I32)
+    W = sf.mem_sym.shape[1]
+    wids = jnp.arange(W)[None, :]
+    win_lo = (a_off // 32)[:, None]
+    win_hi = ((a_off + a_len + 31) // 32)[:, None]
+    any_sym_window = jnp.any(
+        (wids >= win_lo) & (wids < win_hi) & (sf.mem_sym != 0), axis=1
+    )
+    tail_partial = (a_len % 32) != 0
+    tail_sym = tail_partial & (_take_word_sym(sf.mem_sym, w0 + (a_len // 32).astype(I32)) != 0)
+    cd_havoc_new = sf.mem_havoc | (~aligned_a & any_sym_window) | (aligned_a & tail_sym)
+    cd_sym_new = jnp.zeros_like(sf.cd_sym)
+    for w in range(CDW):
+        full_cover = aligned_a & ((32 * (w + 1)) <= a_len)
+        src = _take_word_sym(sf.mem_sym, w0 + w)
+        cd_sym_new = cd_sym_new.at[:, w].set(
+            jnp.where(mi & full_cover & ~cd_havoc_new, src, 0)
+        )
+
+    new_caller = jnp.where(is_deleg[:, None], f.caller_addr, f.self_address).astype(U32)
+    new_value = jnp.where(
+        is_deleg[:, None], f.callvalue,
+        jnp.where(has_value[:, None], value, 0),
+    ).astype(U32)
+    new_value_sym = jnp.where(is_deleg, sf.callvalue_sym, 0)
+    keep_acct = is_deleg | (op == 0xF2)  # DELEGATECALL/CALLCODE keep storage ctx
+
+    f2 = f2.replace(
+        pc=jnp.where(mi, 0, f2.pc),
+        pc_hold=f2.pc_hold | mi,
+        sp=jnp.where(mi | m_push, f.sp - sin + m_push.astype(I32), f2.sp),
+        sp_base=jnp.where(mi, f.sp - sin, f2.sp_base),
+        depth=jnp.where(mi, f.depth + 1, f2.depth),
+        contract_id=jnp.where(mi, callee_code, f2.contract_id),
+        cur_acct=jnp.where(mi, jnp.where(keep_acct, f.cur_acct, slot), f2.cur_acct),
+        caller_addr=jnp.where(mi[:, None], new_caller, f2.caller_addr),
+        callvalue=jnp.where(mi[:, None], new_value, f2.callvalue),
+        static=f2.static | (mi & is_static_op),
+        memory=jnp.where(mi[:, None], 0, f2.memory),
+        mem_words=jnp.where(mi, 0, f2.mem_words),
+        calldata=jnp.where(mi[:, None], callee_cd, f2.calldata),
+        calldata_len=jnp.where(mi, jnp.clip(a_len, 0, CD).astype(I32), f2.calldata_len),
+        returndata_len=jnp.where(mi | m_push, 0, f2.returndata_len),
+        stack=stack,
+    )
+    return sf.replace(
+        base=f2,
+        stack_sym=stack_sym,
+        mem_sym=jnp.where(mi[:, None], 0, sf.mem_sym),
+        mem_havoc=jnp.where(mi, False, sf.mem_havoc | havoc_mem),
+        retdata_sym=jnp.where(mi | eoa_ok | fail0, False,
+                              sf.retdata_sym | external),
+        cd_from_mem=sf.cd_from_mem | mi,
+        cd_havoc=jnp.where(mi, cd_havoc_new, sf.cd_havoc),
+        cd_sym=jnp.where(mi[:, None], cd_sym_new, sf.cd_sym),
+        callvalue_sym=jnp.where(mi, new_value_sym, sf.callvalue_sym),
+        fr_mem_sym=_fr_set(sf.fr_mem_sym, d, sf.mem_sym, mi),
+        fr_mem_havoc=_fr_set(sf.fr_mem_havoc, d, sf.mem_havoc, mi),
+        fr_cd_from_mem=_fr_set(sf.fr_cd_from_mem, d, sf.cd_from_mem, mi),
+        fr_cd_havoc=_fr_set(sf.fr_cd_havoc, d, sf.cd_havoc, mi),
+        fr_cd_sym=_fr_set(sf.fr_cd_sym, d, sf.cd_sym, mi),
+        fr_callvalue_sym=_fr_set(sf.fr_callvalue_sym, d, sf.callvalue_sym, mi),
+        fr_st_val_sym=_fr_set(sf.fr_st_val_sym, d, sf.st_val_sym, mi),
+        fr_st_key_sym=_fr_set(sf.fr_st_key_sym, d, sf.st_key_sym, mi),
+    )
+
+
+def _h_sym_create(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
+    """CREATE/CREATE2: record the event, push a havoc address (init-code
+    execution is a documented gap — creation TRANSACTIONS are modeled at
+    the analysis-wrapper level instead; reference: ``create_`` spawning a
+    ContractCreationTransaction ⚠unv)."""
+    f = sf.base
+    static_viol = m & f.static
+    sf = sf.replace(base=f.trap(static_viol, Trap.STATIC_WRITE))
+    f = sf.base
+    m = m & ~static_viol
+    sin = ci._J_STACK_IN[op]
+    value = ci._peek(f, 0)
+    value_sym = _peek_sym(sf, 0)
+    off = u256.to_u64_saturating(ci._peek(f, 1)).astype(I64)
+    ln = u256.to_u64_saturating(ci._peek(f, 2)).astype(I64)
+    f, _ = ci._expand_memory(f, m & (ln > 0), off + ln)
+    sf = sf.replace(base=f)
+    sf = _record_call_event(sf, m, op, old_pc, jnp.zeros_like(value).astype(U32),
+                            jnp.zeros_like(value_sym), value.astype(U32), value_sym)
+    sf, rv = append_node(sf, m, int(SymOp.FREE), int(FreeKind.RETVAL),
+                         jnp.maximum(sf.n_calls - 1, 0))
+    f = sf.base
+    dest_slot = f.sp - sin
+    stack = ci._set_slot(f.stack, dest_slot, jnp.zeros_like(value), m)
+    return sf.replace(
+        base=f.replace(
+            stack=stack,
+            sp=jnp.where(m, f.sp - sin + 1, f.sp),
+            returndata_len=jnp.where(m, 0, f.returndata_len),
+        ),
+        stack_sym=_set_sym_slot(sf.stack_sym, dest_slot, rv, m),
+    )
+
+
+def pop_frames(sf: SymFrontier) -> SymFrontier:
+    """Return control to the caller for every lane whose sub-frame ended.
+
+    Reference: ``TransactionEndSignal`` handling in ``LaserEVM.exec`` —
+    ``end_message_call`` restores the caller state and pushes the call's
+    success flag (⚠unv, SURVEY.md §3.2). Genuine EVM halts inside the
+    callee (revert, invalid, bad jump, OOG, stack) become success=0 with
+    storage/balance rollback; engine-capacity traps kill the whole lane
+    (the cap is an artifact, not an EVM outcome — counted in coverage).
+    """
+    f = sf.base
+    ended = f.active & (f.depth > 0) & (f.halted | f.error)
+    is_cap = jnp.zeros_like(f.error)
+    for c in CAP_TRAPS:
+        is_cap = is_cap | (f.err_code == c)
+    mp = ended & ~(f.error & is_cap)
+    success = mp & f.halted & ~f.reverted & ~f.error
+    fail = mp & (f.error | f.reverted)
+    d = jnp.maximum(f.depth - 1, 0)
+
+    ret_pc = _fr_get(f.fr_ret_pc, d)
+    csp = _fr_get(f.fr_sp, d)
+    r_off = _fr_get(f.fr_ret_off, d)
+    r_len = _fr_get(f.fr_ret_len, d)
+
+    # caller memory restore + returndata write (REVERT carries data too;
+    # an exceptional halt returns nothing)
+    has_rd = mp & ~f.error
+    memory = jnp.where(mp[:, None], _fr_get(f.fr_memory, d), f.memory)
+    n_rd = jnp.minimum(r_len, f.retval_len.astype(I64))
+    P, M = f.memory.shape
+    jpos = jnp.arange(M, dtype=I64)[None, :]
+    in_win = (jpos >= r_off[:, None]) & (jpos < (r_off + n_rd)[:, None])
+    src = ci._take_per_lane(
+        f.retval, jpos - r_off[:, None], n_rd
+    )
+    memory = jnp.where(in_win & has_rd[:, None], src, memory).astype(jnp.uint8)
+
+    # sym overlay: restore caller's, then map the returndata words
+    mem_sym = jnp.where(mp[:, None], _fr_get(sf.fr_mem_sym, d), sf.mem_sym)
+    mem_havoc = jnp.where(mp, _fr_get(sf.fr_mem_havoc, d), sf.mem_havoc)
+    roff_al = (r_off % 32) == 0
+    RDW = sf.rv_sym.shape[1]
+    rv_words_sym = jnp.any(
+        (jnp.arange(RDW)[None, :] * 32 < n_rd[:, None]) & (sf.rv_sym != 0), axis=1
+    )
+    rv_unknown = sf.rv_havoc | rv_words_sym
+    # aligned full words map exactly; anything messier havocs coarse
+    clean_map = has_rd & roff_al & ~sf.rv_havoc
+    for k in range(RDW):
+        full = (32 * (k + 1)) <= n_rd
+        mem_sym = _set_word_sym(
+            mem_sym, (r_off // 32).astype(I32) + k,
+            sf.rv_sym[:, k], clean_map & full,
+        )
+    tail_sym_rd = ((n_rd % 32) != 0) & jnp.any(
+        (jnp.arange(RDW)[None, :] == (n_rd // 32)[:, None]) & (sf.rv_sym != 0),
+        axis=1,
+    )
+    mem_havoc = mem_havoc | (has_rd & (
+        (sf.rv_havoc & (r_len > 0)) | (~roff_al & rv_words_sym)
+        | (roff_al & tail_sym_rd)
+    ))
+
+    # storage + balance rollback on failure
+    def roll(cur, snap):
+        sel = fail.reshape((P,) + (1,) * (cur.ndim - 1))
+        return jnp.where(sel, snap, cur)
+
+    st_keys = roll(f.st_keys, _fr_get(f.fr_st_keys, d))
+    st_vals = roll(f.st_vals, _fr_get(f.fr_st_vals, d))
+    st_used = roll(f.st_used, _fr_get(f.fr_st_used, d))
+    st_written = roll(f.st_written, _fr_get(f.fr_st_written, d))
+    st_acct = roll(f.st_acct, _fr_get(f.fr_st_acct, d))
+    acct_bal = roll(f.acct_bal, _fr_get(f.fr_acct_bal, d))
+    st_val_sym = roll(sf.st_val_sym, _fr_get(sf.fr_st_val_sym, d))
+    st_key_sym = roll(sf.st_key_sym, _fr_get(sf.fr_st_key_sym, d))
+    gas_min = jnp.where(fail, _fr_get(f.fr_gas_min, d), f.gas_min)
+    gas_max = jnp.where(fail, _fr_get(f.fr_gas_max, d), f.gas_max)
+
+    # success flag pushed at the caller's post-args sp
+    one_w = jnp.zeros((P, 8), dtype=U32).at[:, 0].set(1)
+    res_w = jnp.where(success[:, None], one_w, 0).astype(U32)
+    stack = ci._set_slot(f.stack, csp, res_w, mp)
+    stack_sym = _set_sym_slot(sf.stack_sym, csp, jnp.zeros((P,), I32), mp)
+
+    base = f.replace(
+        pc=jnp.where(mp, ret_pc + 1, f.pc),
+        sp=jnp.where(mp, csp + 1, f.sp),
+        sp_base=jnp.where(mp, _fr_get(f.fr_sp_base, d), f.sp_base),
+        depth=jnp.where(mp, d, f.depth),
+        static=jnp.where(mp, _fr_get(f.fr_static, d), f.static),
+        cur_acct=jnp.where(mp, _fr_get(f.fr_cur_acct, d), f.cur_acct),
+        contract_id=jnp.where(mp, _fr_get(f.fr_contract_id, d), f.contract_id),
+        caller_addr=jnp.where(mp[:, None], _fr_get(f.fr_caller_addr, d), f.caller_addr),
+        callvalue=jnp.where(mp[:, None], _fr_get(f.fr_callvalue, d), f.callvalue),
+        memory=memory,
+        mem_words=jnp.where(mp, _fr_get(f.fr_mem_words, d), f.mem_words),
+        calldata=jnp.where(mp[:, None], _fr_get(f.fr_calldata, d), f.calldata),
+        calldata_len=jnp.where(mp, _fr_get(f.fr_calldata_len, d), f.calldata_len),
+        returndata=jnp.where((mp & has_rd)[:, None], f.retval, f.returndata),
+        returndata_len=jnp.where(mp, jnp.where(has_rd, f.retval_len, 0),
+                                 f.returndata_len),
+        retval_len=jnp.where(mp, 0, f.retval_len),
+        stack=stack,
+        st_keys=st_keys, st_vals=st_vals, st_used=st_used,
+        st_written=st_written, st_acct=st_acct, acct_bal=acct_bal,
+        gas_min=gas_min, gas_max=gas_max,
+        halted=f.halted & ~mp,
+        reverted=f.reverted & ~mp,
+        error=f.error & ~mp,
+        err_code=jnp.where(mp, 0, f.err_code),
+    )
+    return sf.replace(
+        base=base,
+        stack_sym=stack_sym,
+        mem_sym=mem_sym,
+        mem_havoc=mem_havoc,
+        retdata_sym=jnp.where(mp, has_rd & rv_unknown, sf.retdata_sym),
+        rv_sym=jnp.where(mp[:, None], 0, sf.rv_sym),
+        rv_havoc=jnp.where(mp, False, sf.rv_havoc),
+        cd_from_mem=jnp.where(mp, _fr_get(sf.fr_cd_from_mem, d), sf.cd_from_mem),
+        cd_havoc=jnp.where(mp, _fr_get(sf.fr_cd_havoc, d), sf.cd_havoc),
+        cd_sym=jnp.where(mp[:, None], _fr_get(sf.fr_cd_sym, d), sf.cd_sym),
+        callvalue_sym=jnp.where(mp, _fr_get(sf.fr_callvalue_sym, d), sf.callvalue_sym),
+        st_val_sym=st_val_sym,
+        st_key_sym=st_key_sym,
+        sub_revert_pc=jnp.where(fail & (sf.sub_revert_pc < 0), ret_pc,
+                                sf.sub_revert_pc),
     )
 
 
@@ -398,6 +771,9 @@ def _h_sym_claimed_misc(sf: SymFrontier, op, m_memoff, m_sha3off, m_copyoff,
         stack_sym=stack_sym,
         # symbolic-offset stores / copies invalidate the whole memory overlay
         mem_havoc=sf.mem_havoc | (m_memoff & ~is_load) | m_copyoff,
+        # a symbolic-window RETURN/REVERT leaves the payload unknown — the
+        # caller's returndata havocs when this frame pops
+        rv_havoc=sf.rv_havoc | m_haltoff,
     )
 
 
@@ -489,6 +865,9 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     CD = limits.calldata_bytes
     beyond = off64 >= CD
     txb = sf.tx_id
+    # free actor/input leaves exist only at the TOP frame: a sub-frame's
+    # caller/callvalue/calldata are determined by the calling contract
+    at_top = sf.base.depth == 0
 
     kind = jnp.full_like(op, -1)
     bsel = jnp.zeros_like(op)
@@ -501,10 +880,10 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
         bsel = jnp.where(sel, bval, bsel)
 
     # tx-scoped actor/input leaves
-    leaf(spec.caller, op == 0x33, int(FreeKind.CALLER), txb)
-    leaf(spec.callvalue, op == 0x34, int(FreeKind.CALLVALUE), txb)
-    leaf(spec.calldata, op == 0x36, int(FreeKind.CALLDATASIZE), txb)
-    leaf(spec.calldata, is_cdload & (s[0] == 0) & ~beyond,
+    leaf(spec.caller, (op == 0x33) & at_top, int(FreeKind.CALLER), txb)
+    leaf(spec.callvalue, (op == 0x34) & at_top, int(FreeKind.CALLVALUE), txb)
+    leaf(spec.calldata, (op == 0x36) & at_top, int(FreeKind.CALLDATASIZE), txb)
+    leaf(spec.calldata, is_cdload & (s[0] == 0) & ~beyond & at_top,
          int(FreeKind.CALLDATA_WORD),
          (txb.astype(I64) * TX_STRIDE + off64).astype(I32))
     # globals across the tx sequence: ORIGIN always symbolic (the
@@ -514,11 +893,15 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     leaf(spec.block_env, op == 0x43, int(FreeKind.NUMBER), 0)
     leaf(spec.block_env, op == 0x44, int(FreeKind.PREVRANDAO), 0)
     leaf(spec.block_env, op == 0x3A, int(FreeKind.GASPRICE), 0)
-    leaf(spec.block_env, op == 0x47, int(FreeKind.BALANCE), 0)
+    # balances: a symbolic leaf per ACCOUNT SLOT (b = slot) — balances
+    # change under symbolic value transfers, so a concrete table read
+    # could be wrong; known accounts share one leaf per slot, unknown
+    # addresses havoc below
     is_balance = op == 0x31
-    self_query = u256.eq(a[0], env.address) & (s[0] == 0)
-    bal_self = is_balance & self_query
-    leaf(spec.block_env, bal_self, int(FreeKind.BALANCE), 0)
+    known_acct, acct_slot = sf.base.acct_lookup(a[0])
+    known_bal = is_balance & known_acct & (s[0] == 0)
+    leaf(spec.block_env, op == 0x47, int(FreeKind.BALANCE), sf.base.cur_acct)
+    leaf(spec.block_env, known_bal, int(FreeKind.BALANCE), acct_slot)
     # RETURNDATASIZE after a symbolic call
     leaf(True, (op == 0x3D) & sf.retdata_sym, int(FreeKind.RETDATASIZE),
          jnp.maximum(sf.n_calls - 1, 0))
@@ -528,16 +911,41 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
 
     # havoc cases: unknowable values must never collapse to a wrong
     # concrete 0 (EXTCODESIZE/EXTCODEHASH of unknown addresses, BALANCE of
-    # others, BLOCKHASH, symbolic-offset CALLDATALOAD)
-    ext_query = (op == 0x3B) | (op == 0x3F)
+    # unknown addresses, BLOCKHASH, symbolic-offset CALLDATALOAD).
+    # EXTCODESIZE of a table account is answered concretely by the
+    # concrete handler; EXTCODEHASH stays unknowable (no hash modeled).
+    unknown_addr = (s[0] != 0) | ~known_acct
     env_hv_need = m_env & (
         (is_cdload & (s[0] != 0))
-        | (is_balance & ~bal_self)
+        | (is_balance & unknown_addr)
         | (op == 0x40)  # BLOCKHASH
-        | (ext_query & ~self_query)
+        | ((op == 0x3B) & unknown_addr)
+        | (op == 0x3F)  # EXTCODEHASH
     )
+    # sub-frame CALLVALUE / CALLDATALOAD: values flow from the caller's
+    # frame (tracked sym ids), not free leaves
+    sub = ~at_top
+    cv_sub = m_env & (op == 0x34) & sub
+    CDW = sf.cd_sym.shape[1]
+    cw = (off64 // 32).astype(I32)
+    cd_al = (off64 % 32) == 0
+
+    def _cd_sym_at(w):
+        v = jnp.take_along_axis(
+            sf.cd_sym, jnp.clip(w, 0, CDW - 1)[:, None], axis=1
+        )[:, 0]
+        return jnp.where((w >= 0) & (w < CDW), v, 0)
+
+    cda = _cd_sym_at(cw)
+    cdb = _cd_sym_at(cw + 1)
+    cd_sub = m_env & is_cdload & sub & (s[0] == 0)
+    hv_cd_need = cd_sub & (sf.cd_havoc | (~cd_al & ((cda != 0) | (cdb != 0))))
+
+    env_hv_need = env_hv_need | hv_cd_need
     sf, env_hv = _havoc(sf, env_hv_need)
     r_env = jnp.where(need_leaf, env_leaf, 0)
+    r_env = jnp.where(cv_sub, sf.callvalue_sym, r_env)
+    r_env = jnp.where(cd_sub & cd_al & ~sf.cd_havoc, cda, r_env)
     r_env = jnp.where(env_hv_need, env_hv, r_env)
     # "executed ORIGIN" flag (DeprecatedOperations SWC-111): the leaf node
     # may pre-exist via seeding, so presence on the tape is not evidence
@@ -625,9 +1033,15 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     is_cdcopy = op == 0x37
     is_rdcopy = op == 0x3E
     # calldatacopy of symbolic calldata / returndatacopy after a symbolic
-    # call: coarse whole-memory havoc (v1)
+    # call: coarse whole-memory havoc (v1). Sub-frame calldata is only
+    # symbolic where the caller's memory window was.
+    cd_symbolic = jnp.where(
+        at_top,
+        jnp.full_like(sf.cd_havoc, spec.calldata),
+        sf.cd_havoc | jnp.any(sf.cd_sym != 0, axis=1),
+    )
     cd_havoc = m_cp & (cln64 > 0) & (
-        (is_cdcopy & spec.calldata) | (is_rdcopy & sf.retdata_sym)
+        (is_cdcopy & cd_symbolic) | (is_rdcopy & sf.retdata_sym)
     )
     # concrete-source copies (code/extcode/concrete returndata): fully
     # covered words become concrete; partial edge words with stale syms ->
@@ -712,7 +1126,9 @@ def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
     known, ksign = _lookup_constraint(sf, s[1])
     claim_jump = run & (cls == ci.CLS_JUMP) & ((s[0] != 0) | (is_jumpi & (s[1] != 0)))
     claim_storage = run & (cls == ci.CLS_STORAGE)
-    claim_callish = run & ((cls == ci.CLS_CALL) | (cls == ci.CLS_CREATE))
+    claim_call = run & (cls == ci.CLS_CALL)
+    claim_create = run & (cls == ci.CLS_CREATE)
+    claim_callish = claim_call | claim_create
     claim_memoff = run & (cls == ci.CLS_MEM) & (s[0] != 0)
     claim_sha3off = run & (cls == ci.CLS_SHA3) & ((s[0] != 0) | (s[1] != 0))
     is_ext = op == 0x3C
@@ -740,15 +1156,21 @@ def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
                      lambda x: _h_sym_storage(x, spec, op, claim_storage))
     sf = _cond_apply(sf, claim_jump,
                      lambda x: _h_sym_jump(x, corpus, op, claim_jump, old_pc, known, ksign))
-    sf = _cond_apply(sf, claim_callish,
-                     lambda x: _h_sym_callish(x, op, claim_callish, old_pc))
+    sf = _cond_apply(sf, claim_call,
+                     lambda x: _h_sym_call(x, corpus, op, claim_call, old_pc, limits))
+    sf = _cond_apply(sf, claim_create,
+                     lambda x: _h_sym_create(x, op, claim_create, old_pc))
     misc = claim_memoff | claim_sha3off | claim_copyoff | claim_haltoff | claim_logoff
     sf = _cond_apply(sf, misc,
                      lambda x: _h_sym_claimed_misc(x, op, claim_memoff, claim_sha3off,
                                                    claim_copyoff, claim_haltoff, claim_logoff))
 
     f = ci.epilogue(sf.base, op, run, old_pc)
-    return sf.replace(base=f)
+    sf = sf.replace(base=f)
+    # sub-frames that halted (or failed) this step return to their caller
+    any_ended = jnp.any(sf.base.active & (sf.base.depth > 0)
+                        & (sf.base.halted | sf.base.error))
+    return lax.cond(any_ended, pop_frames, lambda x: x, sf)
 
 
 def between_txs(sf: SymFrontier) -> SymFrontier:
@@ -771,6 +1193,9 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
     P = sf.n_lanes
     mutated = jnp.any(b.st_written, axis=1)
     go = b.active & b.halted & ~b.error & ~b.reverted & ~b.selfdestructed & mutated
+    attacker = jnp.broadcast_to(
+        jnp.asarray(u256.from_int(ATTACKER_ADDRESS)), (P, 8)
+    ).astype(jnp.uint32)
     return sf.replace(
         base=b.replace(
             active=go,
@@ -780,6 +1205,13 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
             pc=jnp.where(go, 0, b.pc),
             stack=jnp.where(go[:, None, None], 0, b.stack),
             sp=jnp.where(go, 0, b.sp),
+            depth=jnp.where(go, 0, b.depth),
+            sp_base=jnp.where(go, 0, b.sp_base),
+            static=jnp.where(go, False, b.static),
+            cur_acct=jnp.where(go, b.home_acct, b.cur_acct),
+            contract_id=jnp.where(go, b.home_contract, b.contract_id),
+            caller_addr=jnp.where(go[:, None], attacker, b.caller_addr),
+            callvalue=jnp.where(go[:, None], 0, b.callvalue).astype(jnp.uint32),
             memory=jnp.where(go[:, None], 0, b.memory),
             mem_words=jnp.where(go, 0, b.mem_words),
             gas_min=jnp.where(go, 0, b.gas_min),
@@ -795,6 +1227,12 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         mem_havoc=jnp.where(go, False, sf.mem_havoc),
         retdata_sym=jnp.where(go, False, sf.retdata_sym),
         rv_sym=jnp.where(go[:, None], 0, sf.rv_sym),
+        rv_havoc=jnp.where(go, False, sf.rv_havoc),
+        cd_from_mem=jnp.where(go, False, sf.cd_from_mem),
+        cd_havoc=jnp.where(go, False, sf.cd_havoc),
+        cd_sym=jnp.where(go[:, None], 0, sf.cd_sym),
+        callvalue_sym=jnp.where(go, 0, sf.callvalue_sym),
+        sub_revert_pc=jnp.where(go, -1, sf.sub_revert_pc),
         tx_id=jnp.where(go, sf.tx_id + 1, sf.tx_id),
         # per-tx one-shot event records reset so tx N+1 can't inherit
         # tx N's calls/arith/SSTORE-after-call evidence (the per-tx

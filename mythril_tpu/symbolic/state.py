@@ -57,6 +57,24 @@ class SymFrontier:
     st_val_sym: jnp.ndarray  # i32[P, K]
     st_key_sym: jnp.ndarray  # i32[P, K] sym id of the key stored in the slot
     rv_sym: jnp.ndarray      # i32[P, RD/32] sym ids of the RETURN/REVERT payload
+    rv_havoc: jnp.ndarray    # bool[P] RETURN/REVERT payload unknown (claimed
+    # symbolic-offset halt) — the caller's returndata havocs on pop
+    # --- sub-call frame overlay ---
+    cd_from_mem: jnp.ndarray  # bool[P] calldata is caller memory (depth > 0),
+    # not free symbolic leaves
+    cd_havoc: jnp.ndarray    # bool[P] this frame's calldata bytes unknown
+    cd_sym: jnp.ndarray      # i32[P, CD/32] per-word sym ids of frame calldata
+    callvalue_sym: jnp.ndarray  # i32[P] sym id of this frame's callvalue
+    fr_mem_sym: jnp.ndarray  # i32[P, D, M/32] saved caller memory overlay
+    fr_mem_havoc: jnp.ndarray  # bool[P, D]
+    fr_cd_from_mem: jnp.ndarray  # bool[P, D]
+    fr_cd_havoc: jnp.ndarray  # bool[P, D]
+    fr_cd_sym: jnp.ndarray   # i32[P, D, CD/32]
+    fr_callvalue_sym: jnp.ndarray  # i32[P, D]
+    fr_st_val_sym: jnp.ndarray  # i32[P, D, K] storage-overlay snapshots
+    fr_st_key_sym: jnp.ndarray  # i32[P, D, K]  (revert rollback)
+    sub_revert_pc: jnp.ndarray  # i32[P] pc of the CALL whose callee
+    # reverted/failed (-1 = none; SWC-123 RequirementsViolation feed)
     # --- SSA tape ---
     tape_op: jnp.ndarray     # i32[P, T]
     tape_a: jnp.ndarray      # i32[P, T]
@@ -123,17 +141,20 @@ def make_sym_frontier(
     active=None,
     calldata=None,
     calldata_len=None,
+    **world_kw,
 ) -> SymFrontier:
     """Fresh frontier with the well-known leaves pre-seeded on every tape.
     Concrete ``calldata`` may be supplied for concolic/concrete replay; the
-    default leaves the buffer zeroed (symbolic reads resolve to leaves)."""
+    default leaves the buffer zeroed (symbolic reads resolve to leaves).
+    ``world_kw`` forwards world-state setup (n_contracts, contract_addrs,
+    caller, balances) to :func:`make_frontier`."""
     P = n_lanes
     L = limits
     if calldata_len is None:
         calldata_len = np.full(P, L.calldata_bytes, dtype=np.int32)
     base = make_frontier(
         P, L, contract_id=contract_id, gas_limit=gas_limit, active=active,
-        calldata=calldata, calldata_len=calldata_len,
+        calldata=calldata, calldata_len=calldata_len, **world_kw,
     )
     T, C, K, S = L.tape_len, L.max_constraints, L.storage_slots, L.max_stack
     CL = L.call_log
@@ -150,6 +171,8 @@ def make_sym_frontier(
         t_b[:, i] = idx
 
     z = lambda *s: jnp.zeros(s, dtype=I32)
+    D = L.call_depth
+    CDW = L.calldata_bytes // 32
     return SymFrontier(
         base=base,
         stack_sym=z(P, S),
@@ -159,6 +182,20 @@ def make_sym_frontier(
         st_val_sym=z(P, K),
         st_key_sym=z(P, K),
         rv_sym=z(P, L.returndata_bytes // 32),
+        rv_havoc=jnp.zeros(P, dtype=bool),
+        cd_from_mem=jnp.zeros(P, dtype=bool),
+        cd_havoc=jnp.zeros(P, dtype=bool),
+        cd_sym=z(P, CDW),
+        callvalue_sym=z(P),
+        fr_mem_sym=z(P, D, L.mem_bytes // 32),
+        fr_mem_havoc=jnp.zeros((P, D), dtype=bool),
+        fr_cd_from_mem=jnp.zeros((P, D), dtype=bool),
+        fr_cd_havoc=jnp.zeros((P, D), dtype=bool),
+        fr_cd_sym=z(P, D, CDW),
+        fr_callvalue_sym=z(P, D),
+        fr_st_val_sym=z(P, D, K),
+        fr_st_key_sym=z(P, D, K),
+        sub_revert_pc=jnp.full(P, -1, dtype=I32),
         tape_op=jnp.asarray(t_op),
         tape_a=jnp.asarray(t_a),
         tape_b=jnp.asarray(t_b),

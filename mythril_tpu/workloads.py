@@ -32,6 +32,7 @@ def erc20_transfer_workload(P: int, limits: LimitsConfig):
     f = make_frontier(
         P, limits, calldata=cd,
         calldata_len=np.full(P, TRANSFER_CALLDATA_LEN, dtype=np.int32),
+        caller=BENCH_CALLER,
     )
-    env = make_env(P, caller=BENCH_CALLER)
+    env = make_env(P)
     return code, f, env, corpus

@@ -17,6 +17,7 @@ import sys, os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from mythril_tpu.disassembler import opcodes as oc
+from mythril_tpu.core.frontier import ATTACKER_ADDRESS, CREATOR_ADDRESS
 from mythril_tpu.ops.keccak import keccak256_host_int
 
 M256 = (1 << 256) - 1
@@ -34,11 +35,13 @@ def _u(x):  # signed -> unsigned
 @dataclass
 class RefEnv:
     address: int = 0xAFFE
-    caller: int = 0xDEADBEEF
-    origin: int = 0xDEADBEEF
+    caller: int = ATTACKER_ADDRESS
+    origin: int = ATTACKER_ADDRESS
     callvalue: int = 0
     gasprice: int = 10**9
     balance: int = 10**18
+    # the device world state seeds attacker/creator EOAs with balances
+    eoa_balance: int = 10**20
     coinbase: int = 0xC01BA5E
     timestamp: int = 1_700_000_000
     number: int = 17_000_000
@@ -46,6 +49,13 @@ class RefEnv:
     blk_gaslimit: int = 30_000_000
     chainid: int = 1
     basefee: int = 10**9
+
+    def balance_of(self, a: int) -> int:
+        if a == self.address:
+            return self.balance
+        if a in (ATTACKER_ADDRESS, CREATOR_ADDRESS):
+            return self.eoa_balance
+        return 0
 
 
 @dataclass
@@ -255,7 +265,7 @@ class RefEVM:
             push(self.env.address)
         elif name == "BALANCE":
             a = st.pop()
-            push(self.env.balance if a == self.env.address else 0)
+            push(self.env.balance_of(a))
         elif name == "ORIGIN":
             push(self.env.origin)
         elif name == "CALLER":

@@ -67,13 +67,16 @@ def run_battery(programs, calldatas=None, callvalue=0, max_steps=192):
         cd[i, : len(d)] = np.frombuffer(d, dtype=np.uint8)
         cdl[i] = len(d)
     f = make_frontier(P, L, contract_id=np.arange(P, dtype=np.int32),
-                      calldata=cd, calldata_len=cdl, gas_limit=GAS_LIMIT)
-    env = make_env(P, callvalue=callvalue)
+                      calldata=cd, calldata_len=cdl, gas_limit=GAS_LIMIT,
+                      n_contracts=P, callvalue=callvalue)
+    env = make_env(P)
     out = run(f, env, corpus, max_steps=max_steps)
 
     refs = []
-    for p, d in zip(programs[:n_real], calldatas[:n_real]):
-        r = RefEVM(p, calldata=d, env=RefEnv(callvalue=callvalue),
+    for i, (p, d) in enumerate(zip(programs[:n_real], calldatas[:n_real])):
+        # per-contract address mirrors core.frontier.contract_address
+        r = RefEVM(p, calldata=d,
+                   env=RefEnv(address=0xAFFE + 0x10000 * i, callvalue=callvalue),
                    gas_limit=GAS_LIMIT).run(max_steps=max_steps)
         refs.append(r)
     return out, refs
