@@ -1,0 +1,7 @@
+"""``python -m mythril_tpu`` — the ``myth`` console entry analog."""
+
+import sys
+
+from .interfaces.cli import main
+
+sys.exit(main())

@@ -30,12 +30,13 @@ class ArbitraryJump(DetectionModule):
         issues: List[Issue] = []
         dest = np.asarray(ctx.sf.sym_jump_dest)
         pcs = np.asarray(ctx.sf.sym_jump_pc)
+        cids = np.asarray(ctx.sf.sym_jump_cid)
         for lane in ctx.lanes():
             node = int(dest[lane])
             pc = int(pcs[lane])
             if node == 0 or pc < 0:
                 continue
-            cid = ctx.contract_of(lane)
+            cid = int(cids[lane])
             if self._seen(cid, pc):
                 continue
             tape = ctx.tape(lane)
@@ -54,7 +55,7 @@ class ArbitraryJump(DetectionModule):
                 title="Jump to an arbitrary instruction",
                 severity="High",
                 address=pc,
-                contract=ctx.contract_name(lane),
+                contract=ctx.cid_name(cid),
                 lane=int(lane),
                 description=(
                     "The jump destination is taken from attacker-controlled "

@@ -30,12 +30,13 @@ class ArbitraryStorage(DetectionModule):
         issues: List[Issue] = []
         key_node = np.asarray(ctx.sf.arb_key_node)
         key_pc = np.asarray(ctx.sf.arb_key_pc)
+        cids = np.asarray(ctx.sf.arb_key_cid)
         for lane in ctx.lanes():
             pc = int(key_pc[lane])
             node = int(key_node[lane])
             if pc < 0 or node == 0:
                 continue
-            cid = ctx.contract_of(lane)
+            cid = int(cids[lane])
             if self._seen(cid, pc):
                 continue
             tape = ctx.tape(lane)
@@ -51,7 +52,7 @@ class ArbitraryStorage(DetectionModule):
                 title="Write to an arbitrary storage location",
                 severity="High",
                 address=pc,
-                contract=ctx.contract_name(lane),
+                contract=ctx.cid_name(cid),
                 lane=int(lane),
                 description=(
                     "The SSTORE key is attacker-controlled without hashing; "

@@ -31,7 +31,7 @@ class DelegateCallToUntrustedContract(DetectionModule):
             for ev in calls.lane(lane):
                 if ev.op != 0xF4:
                     continue
-                cid = ctx.contract_of(lane)
+                cid = ev.cid
                 if self._seen(cid, ev.pc):
                     continue
                 tape = ctx.tape(lane)
@@ -47,7 +47,7 @@ class DelegateCallToUntrustedContract(DetectionModule):
                     title="Delegatecall to user-supplied address",
                     severity="High",
                     address=ev.pc,
-                    contract=ctx.contract_name(lane),
+                    contract=ctx.cid_name(cid),
                     lane=int(lane),
                     description=(
                         "DELEGATECALL targets an address taken from "

@@ -35,13 +35,13 @@ class DeprecatedOperations(DetectionModule):
             findings = []
             if used_origin:
                 findings.append(("ORIGIN", "tx.origin is deprecated for "
-                                 "authorization (see also SWC-115)", 0))
+                                 "authorization (see also SWC-115)", 0,
+                                 ctx.contract_of(lane)))
             for ev in calls.lane(lane):
                 if ev.op == 0xF2:
                     findings.append(("CALLCODE", "callcode is deprecated; "
-                                     "use delegatecall", ev.pc))
-            for opname, why, pc in findings:
-                cid = ctx.contract_of(lane)
+                                     "use delegatecall", ev.pc, ev.cid))
+            for opname, why, pc, cid in findings:
                 if self._seen(cid, (opname, pc)):
                     continue
                 issues.append(Issue(
@@ -49,7 +49,7 @@ class DeprecatedOperations(DetectionModule):
                     title=f"Use of {opname}",
                     severity="Low",
                     address=pc,
-                    contract=ctx.contract_name(lane),
+                    contract=ctx.cid_name(cid),
                     lane=int(lane),
                     description=f"Deprecated operation {opname}: {why}.",
                 ))

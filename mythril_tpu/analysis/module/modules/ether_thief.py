@@ -33,7 +33,7 @@ class EtherThief(DetectionModule):
             for ev in calls.lane(lane):
                 if ev.op not in (0xF1, 0xF2):
                     continue
-                cid = ctx.contract_of(lane)
+                cid = ev.cid
                 if self._seen(cid, ev.pc):
                     continue
                 tape = ctx.tape(lane)
@@ -64,7 +64,7 @@ class EtherThief(DetectionModule):
                     title="Unprotected Ether Withdrawal",
                     severity="High",
                     address=ev.pc,
-                    contract=ctx.contract_name(lane),
+                    contract=ctx.cid_name(cid),
                     lane=int(lane),
                     description=(
                         "Any sender can trigger a nonzero-value call to an "

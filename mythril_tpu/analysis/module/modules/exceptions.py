@@ -27,12 +27,13 @@ class Exceptions(DetectionModule):
     def _execute(self, ctx) -> List[Issue]:
         issues: List[Issue] = []
         inv_pc = np.asarray(ctx.sf.inv_pc)
+        cids = np.asarray(ctx.sf.inv_cid)
         # INVALID halts exceptionally, so these lanes carry error=True
         for lane in ctx.lanes(include_errors=True):
             pc = int(inv_pc[lane])
             if pc < 0:
                 continue
-            cid = ctx.contract_of(lane)
+            cid = int(cids[lane])
             if self._seen(cid, pc):
                 continue
             asn = ctx.solve(lane)
@@ -44,7 +45,7 @@ class Exceptions(DetectionModule):
                 title="Exception State",
                 severity="Medium",
                 address=pc,
-                contract=ctx.contract_name(lane),
+                contract=ctx.cid_name(cid),
                 lane=int(lane),
                 description=(
                     "An assert violation (INVALID instruction) is reachable. "

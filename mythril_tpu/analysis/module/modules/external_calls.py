@@ -31,7 +31,7 @@ class ExternalCalls(DetectionModule):
             for ev in calls.lane(lane):
                 if ev.op not in (0xF1, 0xF2, 0xF4, 0xFA):
                     continue
-                cid = ctx.contract_of(lane)
+                cid = ev.cid
                 if self._seen(cid, ev.pc):
                     continue
                 tape = ctx.tape(lane)
@@ -47,7 +47,7 @@ class ExternalCalls(DetectionModule):
                     title="External call to user-supplied address",
                     severity="Medium",
                     address=ev.pc,
-                    contract=ctx.contract_name(lane),
+                    contract=ctx.cid_name(cid),
                     lane=int(lane),
                     description=(
                         "An external message call targets an address taken "

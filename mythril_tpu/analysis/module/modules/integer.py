@@ -46,6 +46,7 @@ class IntegerArithmetics(DetectionModule):
         arith_b = np.asarray(sf.arith_b)
         arith_r = np.asarray(sf.arith_r)
         arith_pc = np.asarray(sf.arith_pc)
+        arith_cid = np.asarray(sf.arith_cid)
         for lane in ctx.lanes():
             n = int(n_arith[lane])
             if n == 0:
@@ -53,7 +54,7 @@ class IntegerArithmetics(DetectionModule):
             for j in range(min(n, arith_op.shape[1])):
                 op = int(arith_op[lane, j])
                 pc = int(arith_pc[lane, j])
-                cid = ctx.contract_of(lane)
+                cid = int(arith_cid[lane, j])
                 if self._seen(cid, pc):
                     continue
                 a = int(arith_a[lane, j])
@@ -89,7 +90,7 @@ class IntegerArithmetics(DetectionModule):
                     title="Integer Arithmetic Bugs",
                     severity="High",
                     address=pc,
-                    contract=ctx.contract_name(lane),
+                    contract=ctx.cid_name(cid),
                     lane=int(lane),
                     description=(
                         "The arithmetic operation can result in integer "

@@ -32,7 +32,7 @@ class MultipleSends(DetectionModule):
             if len(evs) < 2:
                 continue
             second = evs[1]
-            cid = ctx.contract_of(lane)
+            cid = second.cid
             if self._seen(cid, second.pc):
                 continue
             asn = ctx.solve(lane)
@@ -44,7 +44,7 @@ class MultipleSends(DetectionModule):
                 title="Multiple Calls in a Single Transaction",
                 severity="Low",
                 address=second.pc,
-                contract=ctx.contract_name(lane),
+                contract=ctx.cid_name(cid),
                 lane=int(lane),
                 description=(
                     "This path performs multiple external calls; a failure "

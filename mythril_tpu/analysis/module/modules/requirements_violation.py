@@ -32,11 +32,12 @@ class RequirementsViolation(DetectionModule):
     def _execute(self, ctx) -> List[Issue]:
         issues: List[Issue] = []
         sub_pc = np.asarray(ctx.sf.sub_revert_pc)
+        cids = np.asarray(ctx.sf.sub_revert_cid)
         for lane in ctx.lanes(include_reverted=True):
             pc = int(sub_pc[lane])
             if pc < 0:
                 continue
-            cid = ctx.contract_of(lane)
+            cid = int(cids[lane])
             if self._seen(cid, pc):
                 continue
             asn = ctx.solve(lane)
@@ -48,7 +49,7 @@ class RequirementsViolation(DetectionModule):
                 title="Requirement violation in a called contract",
                 severity="Medium",
                 address=pc,
-                contract=ctx.contract_name(lane),
+                contract=ctx.cid_name(cid),
                 lane=int(lane),
                 description=(
                     "A require() of a called contract can be violated by "

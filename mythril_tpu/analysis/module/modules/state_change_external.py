@@ -30,6 +30,7 @@ class StateChangeAfterCall(DetectionModule):
     def _execute(self, ctx) -> List[Issue]:
         issues: List[Issue] = []
         pc_arr = np.asarray(ctx.sf.sstore_after_call_pc)
+        cids = np.asarray(ctx.sf.sstore_ac_cid)
         calls = CallLog(ctx.sf)
         for lane in ctx.lanes():
             pc = int(pc_arr[lane])
@@ -38,7 +39,7 @@ class StateChangeAfterCall(DetectionModule):
             # the engine records this pc only when a re-enterable call
             # (CALL/CALLCODE/DELEGATECALL) preceded the store
             evs = list(calls.lane(lane))
-            cid = ctx.contract_of(lane)
+            cid = int(cids[lane])
             if self._seen(cid, pc):
                 continue
             asn = ctx.solve(lane)
@@ -55,7 +56,7 @@ class StateChangeAfterCall(DetectionModule):
                 title="State change after external call",
                 severity=sev,
                 address=pc,
-                contract=ctx.contract_name(lane),
+                contract=ctx.cid_name(cid),
                 lane=int(lane),
                 description=(
                     "Storage is written after an external call; the callee "

@@ -30,10 +30,11 @@ class AccidentallyKillable(DetectionModule):
         sd = np.asarray(ctx.sf.base.selfdestructed)
         sd_sym = np.asarray(ctx.sf.sd_to_sym)
         pcs = np.asarray(ctx.sf.sd_pc)  # recorded SELFDESTRUCT pc, not live pc
+        cids = np.asarray(ctx.sf.sd_cid)  # contract whose code executed it
         for lane in ctx.lanes():
             if not bool(sd[lane]) or int(pcs[lane]) < 0:
                 continue
-            cid = ctx.contract_of(lane)
+            cid = int(cids[lane])
             pc = int(pcs[lane])
             if self._seen(cid, pc):
                 continue
@@ -51,7 +52,7 @@ class AccidentallyKillable(DetectionModule):
                 title="Unprotected SELFDESTRUCT",
                 severity="High",
                 address=pc,
-                contract=ctx.contract_name(lane),
+                contract=ctx.cid_name(cid),
                 lane=int(lane),
                 description=(
                     "An arbitrary caller can reach SELFDESTRUCT and kill "

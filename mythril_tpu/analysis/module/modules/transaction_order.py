@@ -42,7 +42,7 @@ class TransactionOrderDependence(DetectionModule):
             if int(FreeKind.STORAGE) not in kinds:
                 continue
             ev = transfer[0]
-            cid = ctx.contract_of(lane)
+            cid = ev.cid
             if self._seen(cid, ev.pc):
                 continue
             asn = ctx.solve(lane)
@@ -54,7 +54,7 @@ class TransactionOrderDependence(DetectionModule):
                 title="Transaction order dependence",
                 severity="Medium",
                 address=ev.pc,
-                contract=ctx.contract_name(lane),
+                contract=ctx.cid_name(cid),
                 lane=int(lane),
                 description=(
                     "A value transfer is guarded by storage state that a "

@@ -43,7 +43,7 @@ class UncheckedRetval(DetectionModule):
                 leaf = retval_by_idx.get(ev.idx)
                 if leaf is None or leaf in checked_ids:
                     continue
-                cid = ctx.contract_of(lane)
+                cid = ev.cid
                 if self._seen(cid, ev.pc):
                     continue
                 asn = ctx.solve(lane)
@@ -55,7 +55,7 @@ class UncheckedRetval(DetectionModule):
                     title="Unchecked return value from external call",
                     severity="Medium",
                     address=ev.pc,
-                    contract=ctx.contract_name(lane),
+                    contract=ctx.cid_name(cid),
                     lane=int(lane),
                     description=(
                         "The success flag of an external call is ignored; a "

@@ -12,10 +12,10 @@ from ...ops import u256
 
 
 class CallEvent:
-    __slots__ = ("idx", "op", "pc", "to_sym", "to", "value_sym", "value")
+    __slots__ = ("idx", "op", "pc", "cid", "to_sym", "to", "value_sym", "value")
 
-    def __init__(self, idx, op, pc, to_sym, to, value_sym, value):
-        self.idx, self.op, self.pc = idx, op, pc
+    def __init__(self, idx, op, pc, cid, to_sym, to, value_sym, value):
+        self.idx, self.op, self.pc, self.cid = idx, op, pc, cid
         self.to_sym, self.to = to_sym, to
         self.value_sym, self.value = value_sym, value
 
@@ -27,6 +27,7 @@ class CallLog:
         self.n = np.asarray(sf.n_calls)
         self.op = np.asarray(sf.call_op)
         self.pc = np.asarray(sf.call_pc)
+        self.cid = np.asarray(sf.call_cid)
         self.to_sym = np.asarray(sf.call_to_sym)
         self.to = np.asarray(sf.call_to)
         self.value_sym = np.asarray(sf.call_value_sym)
@@ -38,6 +39,7 @@ class CallLog:
                 idx=j,
                 op=int(self.op[lane, j]),
                 pc=int(self.pc[lane, j]),
+                cid=int(self.cid[lane, j]),
                 to_sym=int(self.to_sym[lane, j]),
                 to=u256.to_int(self.to[lane, j]),
                 value_sym=int(self.value_sym[lane, j]),

@@ -42,6 +42,10 @@ class Issue:
     function: str = ""
     lane: int = -1             # frontier lane that witnessed the issue
     transaction_sequence: Optional[List[Dict]] = None
+    # source mapping (filled when a solidity artifact provided srcmaps)
+    filename: str = ""
+    lineno: Optional[int] = None
+    code_snippet: str = ""
 
     def as_dict(self) -> Dict:
         return {
@@ -53,6 +57,9 @@ class Issue:
             "contract": self.contract,
             "function": self.function,
             "description": self.description,
+            "filename": self.filename,
+            "lineno": self.lineno,
+            "code": self.code_snippet,
             "tx_sequence": self.transaction_sequence,
         }
 
@@ -100,6 +107,21 @@ class Report:
                 f"{cov['saturated_arith_logs']} lane(s) saturated the arithmetic "
                 "event log; later overflow candidates were not recorded."
             )
+        if cov.get("deadline_expired_running"):
+            warn.append(
+                f"execution timeout hit with {cov['deadline_expired_running']} "
+                "path(s) still running; coverage is partial."
+            )
+        solver = (cov.get("solver") or {}).get("total") or {}
+        if solver.get("unknown"):
+            by_mod = {name: s["unknown"]
+                      for name, s in (cov["solver"].get("by_module") or {}).items()
+                      if s.get("unknown")}
+            warn.append(
+                f"{solver['unknown']}/{solver['attempts']} solver queries "
+                f"returned unknown ({by_mod}); candidate findings on those "
+                "paths were dropped."
+            )
         return warn
 
     def as_text(self) -> str:
@@ -117,7 +139,16 @@ class Report:
             out.append(f"SWC ID: {i.swc_id}")
             out.append(f"Severity: {i.severity}")
             out.append(f"Contract: {i.contract or 'Unknown'}")
+            if i.function:
+                out.append(f"Function name: {i.function}")
             out.append(f"PC address: {i.address}")
+            if i.filename:
+                loc = f"In file: {i.filename}"
+                if i.lineno is not None:
+                    loc += f":{i.lineno}"
+                out.append(loc)
+                if i.code_snippet:
+                    out.append(f"  {i.code_snippet}")
             out.append(i.description.strip())
             if i.transaction_sequence:
                 out.append("Transaction Sequence:")

@@ -21,21 +21,65 @@ log = logging.getLogger(__name__)
 def fire_lasers(target, white_list: Optional[List[str]] = None) -> Report:
     """`target` is an AnalysisContext or a SymExecWrapper; a wrapper's
     per-transaction context snapshots are all scanned (module issue caches
-    dedup repeat findings across txs)."""
+    dedup repeat findings across txs). Witness-search statistics are
+    tallied per module (reference: ``SolverStatistics`` ⚠unv, SURVEY §5.1)
+    and attached to the report's coverage block — the ``unknown`` column
+    is the silently-dropped-findings channel (VERDICT r2 weak #3)."""
+    from ..smt.solver import SOLVER_STATS
+
     contexts = getattr(target, "tx_contexts", None) or [target]
     report = Report()
     try:
-        report.coverage = coverage_summary(contexts)
+        # a SymExecWrapper's richer summary (instruction coverage %) wins
+        cov = getattr(target, "coverage", None)
+        report.coverage = cov if isinstance(cov, dict) else coverage_summary(contexts)
     except Exception:  # noqa: BLE001 — accounting must not kill the run
         log.exception("coverage accounting failed")
     loader = ModuleLoader()
     loader.reset_modules()
     modules = loader.get_detection_modules(white_list)
+    run_start = SOLVER_STATS.snapshot()
+    by_module = {}
     for ctx in contexts:
         for module in modules:
+            before = SOLVER_STATS.snapshot()
             try:
                 for issue in module.execute(ctx):
                     report.append(issue)
             except Exception:  # noqa: BLE001 — degrade like the reference
                 log.exception("detection module %s failed", module.name)
+            finally:
+                d = SOLVER_STATS.delta(before)
+                if d["attempts"]:
+                    agg = by_module.setdefault(
+                        module.name,
+                        {"attempts": 0, "sat": 0, "unknown": 0, "time_sec": 0.0})
+                    for k in agg:
+                        agg[k] = round(agg[k] + d[k], 3)
+    if report.coverage is not None:
+        report.coverage["solver"] = {
+            "total": SOLVER_STATS.delta(run_start),
+            "by_module": by_module,
+        }
+    _label_functions(report)
     return report
+
+
+def _label_functions(report: Report) -> None:
+    """Fill ``Issue.function`` from the witness selector via the local
+    signature DB (reference: SignatureDB wiring in the disassembler
+    ⚠unv); unknown selectors keep their hex form."""
+    from ..utils.signatures import SignatureDB
+
+    db = None
+    for issue in report.issues:
+        seq = issue.transaction_sequence
+        if issue.function or not seq:
+            continue
+        inp = seq[-1].get("input", "")
+        if len(inp) < 10:
+            continue
+        if db is None:
+            db = SignatureDB()
+        sigs = db.lookup(inp)
+        issue.function = sigs[0] if sigs else f"0x{inp[2:10]}"

@@ -16,7 +16,7 @@ import numpy as np
 
 from ..config import DEFAULT_LIMITS, LimitsConfig
 from ..core import Corpus, make_env
-from ..core.frontier import CAP_TRAPS, TRAP_NAMES
+from ..core.frontier import ATTACKER_ADDRESS, CAP_TRAPS, TRAP_NAMES
 from ..disassembler import ContractImage
 from ..smt.eval import Assignment
 from ..smt.solver import solve_tape
@@ -37,6 +37,9 @@ class AnalysisContext:
     # SymExecWrapper; None for standalone contexts, where coverage falls
     # back to reading the snapshot directly)
     trap_counts: Optional[Dict[str, int]] = None
+    # exploration of this tx stopped on the wall-clock deadline, not
+    # quiescence (reference: --execution-timeout degrade, SURVEY §5.3)
+    timed_out: bool = False
     _tapes: Dict[int, HostTape] = field(default_factory=dict)
 
     def lanes(self, include_errors: bool = False,
@@ -76,9 +79,17 @@ class AnalysisContext:
     def contract_of(self, lane: int) -> int:
         return int(np.asarray(self.sf.base.contract_id[lane]))
 
+    def cid_name(self, cid: int) -> str:
+        """Display name for a recorded contract id (modules should prefer a
+        per-event ``*_cid`` over ``contract_of``: an event recorded inside a
+        callee frame belongs to the callee's code, not the lane's home
+        contract)."""
+        if 0 <= cid < len(self.contract_names):
+            return self.contract_names[cid]
+        return f"contract_{cid}"
+
     def contract_name(self, lane: int) -> str:
-        cid = self.contract_of(lane)
-        return self.contract_names[cid] if cid < len(self.contract_names) else f"contract_{cid}"
+        return self.cid_name(self.contract_of(lane))
 
     def tx_sequence(self, asn: Assignment) -> List[dict]:
         """Render a witness as the reference-style concrete tx list (one
@@ -138,7 +149,7 @@ def coverage_summary(tx_contexts) -> dict:
     sat_arith = sum(
         int((np.asarray(c.sf.n_arith) > limits.arith_log).sum()) for c in tx_contexts
     )
-    return {
+    out = {
         "lanes": int(np.asarray(final.base.active).shape[0]),
         "surviving_paths": int(
             (np.asarray(final.base.active) & ~np.asarray(final.base.error)).sum()
@@ -150,10 +161,27 @@ def coverage_summary(tx_contexts) -> dict:
         "saturated_call_logs": sat_calls,
         "saturated_arith_logs": sat_arith,
     }
+    if any(getattr(c, "timed_out", False) for c in tx_contexts):
+        still_running = int((np.asarray(final.base.active)
+                             & ~np.asarray(final.base.halted)
+                             & ~np.asarray(final.base.error)).sum())
+        out["deadline_expired_running"] = still_running
+    return out
 
 
 class SymExecWrapper:
-    """Build + run the symbolic exploration for a batch of contracts."""
+    """Build + run the symbolic exploration for a batch of contracts.
+
+    ``creation_bytecodes`` (reference: ``execute_contract_creation`` then
+    message calls, ``mythril/laser/ethereum/transaction/symbolic.py``
+    ⚠unv) enables the creation transaction: each lane first runs its
+    contract's CREATION bytecode with the CREATOR as caller, persists the
+    constructor's storage writes, then switches to the runtime image for
+    the ``transaction_count`` attacker message calls. Constructor
+    arguments (appended to init code in real deployments) read as zero
+    bytes past the compiled length; the RETURN payload is not re-derived —
+    the caller supplies the runtime image, as solc artifacts do.
+    """
 
     def __init__(
         self,
@@ -165,42 +193,180 @@ class SymExecWrapper:
         max_steps: int = 512,
         solver_iters: int = 400,
         transaction_count: int = 1,
+        creation_bytecodes: Optional[Sequence[bytes]] = None,
+        execution_timeout: Optional[float] = None,
+        checkpoint_dir: Optional[str] = None,
+        deadline_chunk_steps: int = 64,
+        plugins: Sequence = (),
+        strategy: str = "bfs",
     ):
+        import time as _time
+
+        from ..core.frontier import CREATOR_ADDRESS
+        from ..plugin.loader import LaserPluginLoader
+
+        self.plugin_loader = LaserPluginLoader()
+        for p in plugins:
+            self.plugin_loader.load(p)
         self.limits = limits
         self.spec = spec
-        images = [ContractImage.from_bytecode(c, limits.max_code) for c in bytecodes]
+        self.max_steps = max_steps
+        # reference strategy names -> fork-admission policies (the
+        # frontier is breadth-first by construction; the policy decides
+        # which forks to ADMIT when slots run short, SURVEY §1 row 7)
+        self.fork_policy = {"bfs": "fifo", "dfs": "deep",
+                            "shallow": "shallow", "deep": "deep",
+                            "fifo": "fifo"}[strategy]
+        self.timed_out = False
+        self.checkpoint_dir = checkpoint_dir
+        self._chunk = max(1, deadline_chunk_steps)
+        self._deadline_at = (
+            None if execution_timeout is None
+            else _time.monotonic() + execution_timeout
+        )
+        runtime_imgs = [ContractImage.from_bytecode(c, limits.max_code)
+                        for c in bytecodes]
+        C = len(runtime_imgs)
+        names = list(contract_names or [f"contract_{i}" for i in range(C)])
+        with_creation = creation_bytecodes is not None
+        if with_creation:
+            assert len(creation_bytecodes) == C
+            creation_imgs = [ContractImage.from_bytecode(c, limits.max_code)
+                             for c in creation_bytecodes]
+            # corpus layout: creation images [0, C), runtime images [C, 2C)
+            images = creation_imgs + runtime_imgs
+            runtime_base = C
+            names = [f"{n} (constructor)" for n in names] + names
+        else:
+            images = runtime_imgs
+            runtime_base = 0
+        self.images = images
         self.corpus = Corpus.from_images(images)
-        C = len(images)
+        self._visited = np.zeros(
+            (len(images), limits.max_code), dtype=bool)
         P = C * lanes_per_contract
-        contract_id = np.repeat(np.arange(C, dtype=np.int32), lanes_per_contract)
+        cid0 = np.repeat(np.arange(C, dtype=np.int32), lanes_per_contract)
+        cid_runtime = cid0 + runtime_base
         active = np.zeros(P, dtype=bool)
         active[::lanes_per_contract] = True  # one seed lane per contract
-        sf = make_sym_frontier(P, limits, contract_id=contract_id, active=active,
-                               n_contracts=C)
+        sf = make_sym_frontier(
+            P, limits, contract_id=cid0, active=active, n_contracts=C,
+            caller=CREATOR_ADDRESS if with_creation else ATTACKER_ADDRESS,
+        )
+        if with_creation:
+            # account table resolves calls/extcode against RUNTIME images
+            b = sf.base
+            import jax.numpy as jnp
+            sf = sf.replace(base=b.replace(
+                acct_code=jnp.where(b.acct_code >= 0, b.acct_code + C,
+                                    b.acct_code),
+            ))
         env = make_env(P)
-        names = list(contract_names or [f"contract_{i}" for i in range(C)])
 
         # multi-tx outer loop (reference: execute_transactions iterating
         # open_states ⚠unv SURVEY.md §3.2): snapshot a context after each
         # tx so detection sees lanes that between_txs retires
         self.tx_contexts: List[AnalysisContext] = []
-        for t in range(transaction_count):
-            sf = sym_run(sf, env, self.corpus, spec, limits, max_steps=max_steps)
+
+        def explore(sf):
+            """One transaction's exploration, chunked when a wall-clock
+            deadline is set (reference: --execution-timeout checked in the
+            exec loop, SURVEY §5.3). Chunks re-enter the same compiled
+            sym_run; between chunks the host checks the clock and may
+            checkpoint."""
+            import time as _time
+
+            if self._deadline_at is None and self.checkpoint_dir is None:
+                sf, vis = sym_run(sf, env, self.corpus, spec, limits,
+                                  max_steps=max_steps, track_coverage=True,
+                                  fork_policy=self.fork_policy)
+                self._visited |= np.asarray(vis)
+                return sf
+            steps_done = 0
+            while steps_done < max_steps:
+                n = min(self._chunk, max_steps - steps_done)
+                sf, vis = sym_run(
+                    sf, env, self.corpus, spec, limits,
+                    max_steps=n,
+                    track_coverage=True, fork_policy=self.fork_policy)
+                self._visited |= np.asarray(vis)
+                steps_done += n
+                self.plugin_loader.fire("on_chunk", sf, steps_done)
+                if self.checkpoint_dir is not None:
+                    self._save_checkpoint(sf, steps_done)
+                if not bool(np.asarray(sf.base.running).any()):
+                    break
+                if (self._deadline_at is not None
+                        and _time.monotonic() >= self._deadline_at):
+                    self.timed_out = True
+                    break
+            return sf
+
+        def run_one_tx(sf, is_last: bool, handoff_kw=None):
+            self.plugin_loader.fire("on_tx_start", self._cur_tx, sf)
+            sf = explore(sf)
             # err_code is zeroed by between_txs, so every nonzero code here
             # is a loss from THIS transaction
             trap_counts = _count_traps(np.asarray(sf.base.err_code))
-            self.tx_contexts.append(AnalysisContext(
+            ctx = AnalysisContext(
                 sf=sf, corpus=self.corpus, limits=limits,
                 contract_names=names, solver_iters=solver_iters,
-                trap_counts=trap_counts,
-            ))
-            if t < transaction_count - 1:
-                sf = between_txs(sf)
-                if not bool(np.asarray(sf.base.active).any()):
-                    break  # no mutating state survived: nothing to extend
+                trap_counts=trap_counts, timed_out=self.timed_out,
+            )
+            self.tx_contexts.append(ctx)
+            self.plugin_loader.fire("on_tx_end", ctx)
+            if not is_last:
+                kw = dict(handoff_kw or {})
+                # with a creation tx, the first MESSAGE call is tx_id 1 —
+                # the dependency pruner must not retire its paths
+                kw.setdefault("first_message_tx", 1 if with_creation else 0)
+                sf = between_txs(sf, **kw)
+            return sf
+
+        self._cur_tx = 0
+        self.plugin_loader.fire("initialize", self)
+        if with_creation:
+            # a constructor needn't mutate storage for the deploy to count
+            sf = run_one_tx(sf, is_last=False, handoff_kw=dict(
+                require_mutation=False, new_contract_id=cid_runtime))
+            self._cur_tx += 1
+        for t in range(transaction_count):
+            if self.timed_out:
+                break  # deadline: report what was explored so far
+            if not bool(np.asarray(sf.base.active).any()):
+                break  # nothing survived: no state left to extend
+            sf = run_one_tx(sf, is_last=(t == transaction_count - 1))
+            self._cur_tx += 1
         self.sf = sf
         self.ctx = self.tx_contexts[-1]
+        self.plugin_loader.fire("on_run_end", self)
+
+    def _save_checkpoint(self, sf, steps_done: int) -> None:
+        import os
+
+        from ..utils.checkpoint import save_frontier
+
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        save_frontier(
+            os.path.join(self.checkpoint_dir, "frontier.npz"), sf,
+            {"tx": self._cur_tx, "steps_done": steps_done},
+        )
+
+    def instruction_coverage(self) -> Dict[str, float]:
+        """Per-contract % of real instructions reached (reference:
+        InstructionCoveragePlugin's end-of-run log ⚠unv, SURVEY §2)."""
+        out = {}
+        names = self.tx_contexts[-1].contract_names if self.tx_contexts else []
+        for ci, img in enumerate(self.images):
+            starts = img.is_code
+            n = int(starts.sum())
+            hit = int((self._visited[ci] & starts).sum())
+            name = names[ci] if ci < len(names) else f"contract_{ci}"
+            out[name] = round(100.0 * hit / n, 1) if n else 100.0
+        return out
 
     @property
     def coverage(self) -> dict:
-        return coverage_summary(self.tx_contexts)
+        cov = coverage_summary(self.tx_contexts)
+        cov["instruction_coverage_pct"] = self.instruction_coverage()
+        return cov

@@ -33,6 +33,9 @@ class LimitsConfig:
     call_log: int = 16  # recorded external-call events per lane
     arith_log: int = 32  # recorded symbolic-arithmetic events per lane
     propagate_every: int = 8  # supersteps between feasibility sweeps
+    loop_bound: int = 8  # max taken backward jumps to one target per lane
+    # (0 disables; reference: BoundedLoopsStrategy --loop-bound ⚠unv)
+    loop_slots: int = 8  # tracked distinct back-jump targets per lane
 
     def __post_init__(self):
         assert self.max_stack >= 17  # SWAP16 arity
@@ -58,4 +61,6 @@ TEST_LIMITS = LimitsConfig(
     call_log=4,
     arith_log=8,
     propagate_every=4,
+    loop_bound=4,
+    loop_slots=4,
 )

@@ -46,6 +46,8 @@ class Trap:
     CONSTRAINT_LIMIT = 9  # path-condition slots full
     STATIC_WRITE = 10    # state modification inside a STATICCALL frame
     ACCOUNTS_FULL = 11   # world-state account table full
+    LOOP_BOUND = 12      # retired by the bounded-loops policy (intentional
+    # pruning, reference: BoundedLoopsStrategy ⚠unv — not a capacity loss)
 
 
 TRAP_NAMES = {
@@ -60,12 +62,18 @@ TRAP_NAMES = {
     Trap.CONSTRAINT_LIMIT: "constraint_cap",
     Trap.STATIC_WRITE: "static_write",
     Trap.ACCOUNTS_FULL: "accounts_cap",
+    Trap.LOOP_BOUND: "loop_bound",
 }
 
 # trap codes that are capacity artifacts of this engine (coverage loss)
 # rather than genuine EVM exceptional halts
 CAP_TRAPS = (Trap.STACK, Trap.OOB_MEM, Trap.STORAGE_SLOTS, Trap.HASH_LIMIT,
              Trap.TAPE_LIMIT, Trap.CONSTRAINT_LIMIT, Trap.ACCOUNTS_FULL)
+
+# traps that KILL a lane outright even inside a sub-frame (pop_frames must
+# not convert them into a callee failure the caller observes): capacity
+# artifacts plus intentional loop-bound retirement
+KILL_TRAPS = CAP_TRAPS + (Trap.LOOP_BOUND,)
 
 
 # Reference's well-known actors (mythril/laser/ethereum/transaction ⚠unv).
@@ -79,6 +87,9 @@ CREATOR_ADDRESS = 0xAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFEAFFE
 ACCT_ATTACKER = 0
 ACCT_CREATOR = 1
 ACCT_CONTRACT0 = 2
+
+# acct_code sentinel: the account has code, but not in the corpus
+CODE_UNKNOWN = -2
 
 
 def contract_address(i: int) -> int:
@@ -136,7 +147,9 @@ class Frontier:
     fr_acct_bal: jnp.ndarray  # u32[P, D, A, 8]
     # --- per-lane world state (reference: WorldState/Account ⚠unv) ---
     acct_addr: jnp.ndarray  # u32[P, A, 8]
-    acct_code: jnp.ndarray  # i32[P, A] corpus index (-1 = EOA / no code)
+    acct_code: jnp.ndarray  # i32[P, A] corpus index (-1 = EOA / no code;
+    # CODE_UNKNOWN=-2 = account HAS code the corpus doesn't hold, e.g. a
+    # CREATE result — calls to it must take the external-havoc path)
     acct_bal: jnp.ndarray  # u32[P, A, 8]
     acct_used: jnp.ndarray  # bool[P, A]
     # --- stack ---
@@ -163,8 +176,15 @@ class Frontier:
     retval: jnp.ndarray  # u8[P, RD] RETURN/REVERT payload of this frame
     retval_len: jnp.ndarray  # i32[P]
     # --- events ---
-    n_logs: jnp.ndarray  # i32[P]
+    n_logs: jnp.ndarray  # i32[P] LOG attempts (records cap at log_slots)
+    log_pc: jnp.ndarray  # i32[P, LS] pc of each recorded LOG
+    log_cid: jnp.ndarray  # i32[P, LS] contract executing it
+    log_ntopics: jnp.ndarray  # i32[P, LS] 0..4
+    log_topic0: jnp.ndarray  # u32[P, LS, 8] first topic (event signature)
+    log_data0: jnp.ndarray  # u32[P, LS, 8] first 32 bytes of the payload
     selfdestructed: jnp.ndarray  # bool[P] executed SELFDESTRUCT
+    # --- metrics (reference: BenchmarkPlugin states/sec ⚠unv, SURVEY §5.1) ---
+    n_steps: jnp.ndarray  # i32[P] instructions this lane actually executed
 
     @property
     def n_lanes(self) -> int:
@@ -385,7 +405,13 @@ def make_frontier(
         retval=jnp.zeros((P, L.returndata_bytes), dtype=jnp.uint8),
         retval_len=jnp.zeros(P, dtype=jnp.int32),
         n_logs=jnp.zeros(P, dtype=jnp.int32),
+        log_pc=jnp.zeros((P, L.log_slots), dtype=jnp.int32),
+        log_cid=jnp.zeros((P, L.log_slots), dtype=jnp.int32),
+        log_ntopics=jnp.zeros((P, L.log_slots), dtype=jnp.int32),
+        log_topic0=z8(P, L.log_slots),
+        log_data0=z8(P, L.log_slots),
         selfdestructed=jnp.zeros(P, dtype=bool),
+        n_steps=jnp.zeros(P, dtype=jnp.int32),
     )
 
 

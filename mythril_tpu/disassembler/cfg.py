@@ -1,0 +1,143 @@
+"""Static control-flow graph over disassembled bytecode.
+
+Reference: ``mythril/laser/ethereum/cfg.py`` (⚠unv, SURVEY.md §2 row
+"CFG") builds Node/Edge/JumpType DURING symbolic execution. Frontier-
+first that bookkeeping would serialize the hot loop, so the graph here is
+built STATICALLY from the instruction stream (basic blocks, fall-through,
+push-immediate jump targets — which covers solc's dispatcher and loop
+shapes), and the exploration's visited-pc bitmap (``sym_run
+track_coverage``) can be overlaid afterwards to mark reached blocks.
+Feeds ``--graph`` DOT output; the bounded-loops policy intentionally does
+NOT depend on it (it counts dynamic back-jumps per lane instead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .disassembly import EvmInstruction, disassemble
+
+BLOCK_ENDERS = {"JUMP", "JUMPI", "STOP", "RETURN", "REVERT", "SELFDESTRUCT",
+                "INVALID"}
+
+
+class JumpType(Enum):
+    CONDITIONAL = "conditional"
+    UNCONDITIONAL = "unconditional"
+    FALLTHROUGH = "fallthrough"
+
+
+@dataclass
+class Node:
+    uid: int
+    start: int                    # pc of first instruction
+    end: int                      # pc of last instruction
+    instructions: List[EvmInstruction] = field(default_factory=list)
+    reached: Optional[bool] = None  # filled from a visited bitmap
+
+    @property
+    def label(self) -> str:
+        head = f"{self.start}..{self.end}"
+        body = "\\l".join(
+            f"{i.address} {i.name}"
+            + (f" 0x{i.argument.hex()}" if i.argument else "")
+            for i in self.instructions[:20]
+        )
+        more = "\\l..." if len(self.instructions) > 20 else ""
+        return f"{head}\\l{body}{more}\\l"
+
+
+@dataclass
+class Edge:
+    src: int    # node uid
+    dst: int
+    jump_type: JumpType
+
+
+class CFG:
+    """Basic blocks + static edges for one contract's bytecode."""
+
+    def __init__(self, code: bytes):
+        self.instructions = disassemble(code)
+        self.nodes: List[Node] = []
+        self.edges: List[Edge] = []
+        self._build()
+
+    def _build(self) -> None:
+        instrs = self.instructions
+        if not instrs:
+            return
+        # leaders: entry, jumpdests, instruction after a block ender
+        leaders = {instrs[0].address}
+        for i, ins in enumerate(instrs):
+            if ins.name == "JUMPDEST":
+                leaders.add(ins.address)
+            if ins.name in BLOCK_ENDERS and i + 1 < len(instrs):
+                leaders.add(instrs[i + 1].address)
+        node_of_pc: Dict[int, int] = {}
+        cur: Optional[Node] = None
+        for ins in instrs:
+            if ins.address in leaders or cur is None:
+                cur = Node(uid=len(self.nodes), start=ins.address,
+                           end=ins.address)
+                self.nodes.append(cur)
+            cur.instructions.append(ins)
+            cur.end = ins.address
+            node_of_pc[ins.address] = cur.uid
+        self._node_of_pc = node_of_pc
+
+        # edges
+        for n in self.nodes:
+            last = n.instructions[-1]
+            nxt = last.address + 1 + len(last.argument or b"")
+            if last.name == "JUMPI" and nxt in node_of_pc:
+                self.edges.append(Edge(n.uid, node_of_pc[nxt],
+                                       JumpType.FALLTHROUGH))
+            elif last.name not in BLOCK_ENDERS and nxt in node_of_pc:
+                self.edges.append(Edge(n.uid, node_of_pc[nxt],
+                                       JumpType.FALLTHROUGH))
+            if last.name in ("JUMP", "JUMPI"):
+                tgt = self._static_target(n)
+                if tgt is not None and tgt in node_of_pc:
+                    jt = (JumpType.CONDITIONAL if last.name == "JUMPI"
+                          else JumpType.UNCONDITIONAL)
+                    self.edges.append(Edge(n.uid, node_of_pc[tgt], jt))
+
+    @staticmethod
+    def _static_target(n: Node) -> Optional[int]:
+        """PUSH immediately feeding the jump (solc's canonical shape)."""
+        if len(n.instructions) >= 2:
+            prev = n.instructions[-2]
+            if prev.name.startswith("PUSH") and prev.argument:
+                return int.from_bytes(prev.argument, "big")
+        return None
+
+    def node_at(self, pc: int) -> Optional[Node]:
+        uid = self._node_of_pc.get(pc)
+        return self.nodes[uid] if uid is not None else None
+
+    def mark_reached(self, visited: np.ndarray) -> None:
+        """Overlay a visited-pc bitmap (bool[max_code]) from sym_run."""
+        for n in self.nodes:
+            n.reached = bool(visited[n.start]) if n.start < len(visited) else False
+
+    def as_dot(self, name: str = "cfg") -> str:
+        out = [f'digraph "{name}" {{', '  node [shape=box fontname="monospace"];']
+        for n in self.nodes:
+            style = ""
+            if n.reached is True:
+                style = ' style=filled fillcolor="#c8e6c9"'
+            elif n.reached is False:
+                style = ' style=filled fillcolor="#eeeeee"'
+            out.append(f'  n{n.uid} [label="{n.label}"{style}];')
+        styles = {JumpType.CONDITIONAL: "dashed",
+                  JumpType.UNCONDITIONAL: "solid",
+                  JumpType.FALLTHROUGH: "dotted"}
+        for e in self.edges:
+            out.append(f'  n{e.src} -> n{e.dst} [style={styles[e.jump_type]}];')
+        out.append("}")
+        return "\n".join(out)

@@ -1,0 +1,1 @@
+"""User-facing interfaces (reference: ``mythril/interfaces/`` ⚠unv)."""

@@ -1,0 +1,194 @@
+"""``myth``-style command line (reference: ``mythril/interfaces/cli.py``
+⚠unv, SURVEY.md §2 row "CLI").
+
+Commands: ``analyze`` (``a``), ``disassemble`` (``d``),
+``list-detectors``, ``version``. Flag names follow the reference where
+the concept carries over (``-t``, ``-m``, ``-o``, ``--loop-bound``,
+``--execution-timeout``); TPU-frontier knobs (``--max-steps``,
+``--lanes-per-contract``) replace the reference's per-state depth flags.
+
+Run as ``python -m mythril_tpu <command> ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def create_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mythril_tpu",
+        description="TPU-native symbolic-execution security analyzer for EVM bytecode",
+    )
+    sub = p.add_subparsers(dest="command")
+
+    def add_input_flags(cmd):
+        cmd.add_argument("-f", "--codefile", metavar="PATH",
+                         help="file holding runtime bytecode as hex")
+        cmd.add_argument("-c", "--code", metavar="HEX",
+                         help="runtime bytecode as a hex string")
+        cmd.add_argument("--creation-code", metavar="PATH",
+                         help="file holding CREATION bytecode as hex; enables "
+                              "the constructor transaction")
+        cmd.add_argument("--artifact", metavar="PATH",
+                         help="solc standard-JSON output artifact (loads all "
+                              "contracts with source maps)")
+        cmd.add_argument("--solc-input", metavar="PATH",
+                         help="solc standard-JSON INPUT (source text for line "
+                              "numbers; used with --artifact)")
+        cmd.add_argument("--name", default="MAIN", help="contract display name")
+
+    a = sub.add_parser("analyze", aliases=["a"], help="symbolically analyze bytecode")
+    add_input_flags(a)
+    a.add_argument("-t", "--transaction-count", type=int, default=2,
+                   help="number of attacker message-call transactions")
+    a.add_argument("-m", "--modules", metavar="LIST",
+                   help="comma-separated detection-module allow list")
+    a.add_argument("-o", "--outform", choices=["text", "markdown", "json"],
+                   default="text")
+    a.add_argument("--max-steps", type=int, default=512,
+                   help="superstep budget per transaction")
+    a.add_argument("--lanes-per-contract", type=int, default=64,
+                   help="frontier lanes (seed + fork headroom) per contract")
+    a.add_argument("--loop-bound", type=int, default=None,
+                   help="max taken backward jumps per loop target (bounded-"
+                        "loops policy)")
+    a.add_argument("--solver-iters", type=int, default=400,
+                   help="witness-search repair iterations per query")
+    a.add_argument("--execution-timeout", type=float, default=None,
+                   help="wall-clock budget in seconds for the exploration")
+    a.add_argument("--strategy", choices=["bfs", "dfs"], default="bfs",
+                   help="fork-admission policy when frontier slots run "
+                        "short (the frontier itself steps breadth-first)")
+    a.add_argument("--limits-profile", choices=["default", "test"],
+                   default="default",
+                   help="frontier shape caps: 'test' compiles a much "
+                        "smaller engine (CI / quick scans)")
+    a.add_argument("--concrete-storage", action="store_true",
+                   help="model unknown storage as zero instead of symbolic "
+                        "(reference default; symbolic is --unconstrained-storage there)")
+    a.add_argument("--graph", metavar="PATH",
+                   help="write the contract CFG as graphviz DOT, explored "
+                        "blocks highlighted")
+
+    d = sub.add_parser("disassemble", aliases=["d"], help="print EASM")
+    add_input_flags(d)
+
+    sub.add_parser("list-detectors", help="list registered detection modules")
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def _load_contracts(args):
+    from ..mythril import MythrilDisassembler
+
+    if getattr(args, "artifact", None):
+        from ..solidity import get_contracts_from_standard_json
+
+        contracts = get_contracts_from_standard_json(
+            args.artifact, getattr(args, "solc_input", None))
+        if not contracts:
+            print("error: artifact holds no deployed bytecode", file=sys.stderr)
+            raise SystemExit(2)
+        return contracts
+    if args.code:
+        return [MythrilDisassembler.load_from_bytecode(args.code, name=args.name)]
+    if args.codefile:
+        return [MythrilDisassembler.load_from_file(
+            args.codefile, creation_path=args.creation_code, name=args.name)]
+    print("error: provide bytecode via -c/--code, -f/--codefile, or --artifact",
+          file=sys.stderr)
+    raise SystemExit(2)
+
+
+def exec_analyze(args) -> int:
+    import dataclasses
+
+    from ..mythril import MythrilAnalyzer, MythrilConfig
+    from ..symbolic import SymSpec
+
+    contracts = _load_contracts(args)
+    if args.code and args.creation_code:
+        with open(args.creation_code) as fh:
+            from ..disassembler.disassembly import _to_bytes
+
+            contracts[0] = dataclasses.replace(
+                contracts[0], creation_code=_to_bytes(fh.read()))
+    from ..config import DEFAULT_LIMITS, TEST_LIMITS
+
+    cfg = MythrilConfig(
+        limits=TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS,
+        transaction_count=args.transaction_count,
+        max_steps=args.max_steps,
+        lanes_per_contract=args.lanes_per_contract,
+        solver_iters=args.solver_iters,
+        loop_bound=args.loop_bound,
+        execution_timeout=args.execution_timeout,
+        strategy=args.strategy,
+        spec=SymSpec(storage=not args.concrete_storage),
+    )
+    analyzer = MythrilAnalyzer(contracts, cfg)
+    modules = args.modules.split(",") if args.modules else None
+    report = analyzer.fire_lasers(modules=modules)
+    if args.graph:
+        _write_graph(args.graph, contracts[0], analyzer)
+    if args.outform == "json":
+        print(report.as_json())
+    elif args.outform == "markdown":
+        print(report.as_markdown())
+    else:
+        print(report.as_text())
+    return 0
+
+
+def _write_graph(path: str, contract, analyzer) -> None:
+    """DOT CFG of the first contract, explored blocks highlighted."""
+    from ..disassembler.cfg import CFG
+
+    cfg = CFG(contract.code)
+    sym = analyzer.sym
+    if sym is not None and getattr(sym, "_visited", None) is not None:
+        # runtime image index: with creation bytecodes the runtime images
+        # occupy the second half of the corpus
+        ci = len(sym.images) - len(analyzer.contracts)
+        cfg.mark_reached(sym._visited[ci])
+    with open(path, "w") as fh:
+        fh.write(cfg.as_dot(contract.name))
+
+
+def exec_disassemble(args) -> int:
+    contract = _load_contracts(args)[0]
+    print(contract.get_easm(), end="")
+    return 0
+
+
+def exec_list_detectors(args) -> int:
+    from ..analysis import ModuleLoader
+
+    for m in ModuleLoader().get_detection_modules():
+        print(f"{m.name} (SWC-{m.swc_id}): {m.description}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = create_parser()
+    args = parser.parse_args(argv)
+    if args.command in ("analyze", "a"):
+        return exec_analyze(args)
+    if args.command in ("disassemble", "d"):
+        return exec_disassemble(args)
+    if args.command == "list-detectors":
+        return exec_list_detectors(args)
+    if args.command == "version":
+        from .. import __version__
+
+        print(f"mythril_tpu {__version__}")
+        return 0
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,13 @@
+"""Orchestration layer (reference: ``mythril/mythril/`` ⚠unv).
+
+``MythrilConfig`` + ``MythrilDisassembler`` + ``MythrilAnalyzer`` are the
+front door between the CLI and the analysis stack: loading turns hex
+blobs / files into :class:`EVMContract`s, analysis drives
+``SymExecWrapper`` + ``fire_lasers`` and returns a :class:`Report`.
+"""
+
+from .orchestration import (EVMContract, MythrilAnalyzer, MythrilConfig,
+                            MythrilDisassembler)
+
+__all__ = ["EVMContract", "MythrilAnalyzer", "MythrilConfig",
+           "MythrilDisassembler"]

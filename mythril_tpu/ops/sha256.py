@@ -1,0 +1,122 @@
+"""SHA-256 — batched JAX kernel (+ host reference via hashlib).
+
+The reference's sha256 precompile delegates to Python's ``hashlib`` (C)
+(``mythril/laser/ethereum/natives.py`` ⚠unv, SURVEY.md §2 "Precompiles").
+Here the compression function is pure u32 bitwise ops over the whole
+frontier: hashing P lanes of up-to-N bytes is one fused XLA op sequence —
+the same design as :mod:`.keccak`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+U32 = jnp.uint32
+U8 = jnp.uint8
+I32 = jnp.int32
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+_H0 = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+
+def _rotr(x, n: int):
+    return (x >> U32(n)) | (x << U32(32 - n))
+
+
+def sha256_device(data: jnp.ndarray, ln: jnp.ndarray) -> jnp.ndarray:
+    """SHA-256 of per-lane byte buffers.
+
+    ``data`` u8[P, N] (bytes past ``ln`` ignored), ``ln`` i32[P] logical
+    lengths (0 <= ln <= N). Returns the digest as u256 limbs u32[P, 8]
+    (little-endian limb order, the frontier word format).
+    """
+    P, N = data.shape
+    max_blocks = (N + 9 + 63) // 64
+    M = max_blocks * 64
+
+    # build padded message: msg || 0x80 || 0* || len64_be
+    k = jnp.arange(M)
+    d = jnp.where(k[None, :] < N,
+                  jnp.pad(data, ((0, 0), (0, M - N))), 0).astype(U32)
+    in_msg = k[None, :] < ln[:, None]
+    is_pad1 = k[None, :] == ln[:, None]
+    msg = jnp.where(in_msg, d, jnp.where(is_pad1, 0x80, 0))
+    # bit length goes in the last 8 bytes of the lane's final block
+    n_blocks = (ln + 9 + 63) // 64
+    total = n_blocks * 64
+    bitlen = (ln.astype(jnp.uint64) * 8)
+    len_pos = k[None, :] - (total - 8)[:, None]  # 0..7 inside the length field
+    len_byte = jnp.where(
+        (len_pos >= 0) & (len_pos < 8),
+        (bitlen[:, None] >> ((7 - jnp.maximum(len_pos, 0)).astype(jnp.uint64) * 8))
+        & 0xFF,
+        0,
+    ).astype(U32)
+    msg = jnp.where((len_pos >= 0) & (len_pos < 8), len_byte, msg)
+
+    # bytes -> big-endian u32 words [P, M/4]
+    w32 = (
+        (msg[:, 0::4] << U32(24)) | (msg[:, 1::4] << U32(16))
+        | (msg[:, 2::4] << U32(8)) | msg[:, 3::4]
+    ).astype(U32)
+
+    K = jnp.asarray(_K)
+    state0 = jnp.broadcast_to(jnp.asarray(_H0), (P, 8)).astype(U32)
+
+    def block(b, state):
+        w = jnp.zeros((P, 64), dtype=U32)
+        w = w.at[:, :16].set(lax.dynamic_slice_in_dim(w32, b * 16, 16, axis=1))
+
+        def sched(t, w):
+            s0 = _rotr(w[:, t - 15], 7) ^ _rotr(w[:, t - 15], 18) ^ (w[:, t - 15] >> U32(3))
+            s1 = _rotr(w[:, t - 2], 17) ^ _rotr(w[:, t - 2], 19) ^ (w[:, t - 2] >> U32(10))
+            return w.at[:, t].set(w[:, t - 16] + s0 + w[:, t - 7] + s1)
+
+        for t in range(16, 64):
+            w = sched(t, w)
+
+        def rnd(t, hv):
+            a, bb, c, dd, e, f, g, h = [hv[:, i] for i in range(8)]
+            S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            t1 = h + S1 + ch + K[t] + w[:, t]
+            S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            mj = (a & bb) ^ (a & c) ^ (bb & c)
+            t2 = S0 + mj
+            return jnp.stack([t1 + t2, a, bb, c, dd + t1, e, f, g], axis=1)
+
+        hv = lax.fori_loop(0, 64, rnd, state)
+        new_state = state + hv
+        # blocks past the lane's message leave the state untouched
+        live = (b < n_blocks)[:, None]
+        return jnp.where(live, new_state, state)
+
+    state = lax.fori_loop(0, max_blocks, block, state0)
+
+    # big-endian digest words -> u256 limbs (little-endian limb order:
+    # limb 0 = least-significant 32 bits = last digest word)
+    return state[:, ::-1]
+
+
+def sha256_host(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()

@@ -401,6 +401,29 @@ def mulmod(a, b, m):
     return _mod_wide(mul_wide(a, b), m)
 
 
+def modexp(base, e, m):
+    """base ** e mod m (m == 0 -> 0), square-and-multiply MSB-first.
+
+    Serves the 0x05 MODEXP precompile for <= 32-byte operands. Cost: 256
+    iterations of two long-division mulmods — expensive, but the caller
+    gates it behind ``lax.cond`` so frontiers without MODEXP never pay."""
+    base, e, m = jnp.broadcast_arrays(base, e, m)
+    batch = base.shape[:-1]
+
+    def body(k, acc):
+        i = 255 - k
+        limb = i // LIMB_BITS
+        shift = i % LIMB_BITS
+        bit = ((jnp.take(e, limb, axis=-1) >> _U32(shift)) & _U32(1)) != 0
+        acc = mulmod(acc, acc, m)
+        acc = jnp.where(bit[..., None], mulmod(acc, base, m), acc)
+        return acc
+
+    one = jnp.broadcast_to(jnp.asarray(from_int(1)), batch + (NLIMBS,)).astype(_U32)
+    r = jax.lax.fori_loop(0, 256, body, one)
+    return jnp.where(is_zero(m)[..., None], 0, r).astype(_U32)
+
+
 # ---------------------------------------------------------------------------
 # Exp / SignExtend / Byte / Shifts
 # ---------------------------------------------------------------------------

@@ -1,0 +1,18 @@
+"""Laser plugin framework (reference: ``mythril/laser/plugin/`` ⚠unv).
+
+The reference instruments the per-opcode exec loop with Python hooks —
+impossible frontier-first without serializing the superstep. The hook
+surface here is the HOST boundary instead: transaction starts/ends,
+chunk boundaries (when a deadline/checkpoint chunks the run), and run
+end. That is where the reference's shipped plugins actually live too:
+coverage/benchmark read state at boundaries, and the pruners
+(mutation/dependency/bounded-loops) are lane-kill policies already fused
+into the engine (``between_txs`` / ``_note_backjump``).
+"""
+
+from .interface import LaserPlugin, PluginBuilder
+from .loader import LaserPluginLoader
+from .plugins import BenchmarkPlugin, CoveragePlugin
+
+__all__ = ["LaserPlugin", "PluginBuilder", "LaserPluginLoader",
+           "BenchmarkPlugin", "CoveragePlugin"]

@@ -135,7 +135,8 @@ def _free_value(node_id: int, kind: int, index: int, asn: Assignment) -> int:
         t = asn.tx(index)
         return t.calldatasize if t.calldatasize is not None else len(t.calldata)
     if kind in (int(FreeKind.STORAGE), int(FreeKind.RETVAL), int(FreeKind.HAVOC),
-                int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH)):
+                int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH),
+                int(FreeKind.ECRECOVER), int(FreeKind.PRECOMPILE)):
         return asn.by_node.get(node_id, 0)
     # block-env leaves default to plausible mainnet-ish values
     defaults = {

@@ -14,6 +14,7 @@ degrade-to-no-issue semantics as the reference's solver timeout
 from __future__ import annotations
 
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -24,6 +25,54 @@ from .tape import HostTape
 
 class UnsatError(Exception):
     """No witness found (unsat OR search exhausted — like a Z3 timeout)."""
+
+
+@dataclass
+class SolverStatistics:
+    """Run counters for the witness search (reference:
+    ``laser/smt/solver/solver_statistics.py`` ⚠unv, SURVEY.md §5.1).
+    ``unknown`` is the silent false-negative channel (VERDICT r2 weak #3):
+    every query that returns None and therefore drops a candidate finding
+    is counted here, so the undecided rate is observable in the report."""
+
+    attempts: int = 0
+    sat: int = 0
+    unknown: int = 0
+    time_sec: float = 0.0
+
+    def record(self, found: bool, dt: float) -> None:
+        self.attempts += 1
+        if found:
+            self.sat += 1
+        else:
+            self.unknown += 1
+        self.time_sec += dt
+
+    def reset(self) -> None:
+        self.attempts = self.sat = self.unknown = 0
+        self.time_sec = 0.0
+
+    def snapshot(self) -> "SolverStatistics":
+        return SolverStatistics(self.attempts, self.sat, self.unknown,
+                                self.time_sec)
+
+    def delta(self, since: "SolverStatistics") -> dict:
+        return {
+            "attempts": self.attempts - since.attempts,
+            "sat": self.sat - since.sat,
+            "unknown": self.unknown - since.unknown,
+            "time_sec": round(self.time_sec - since.time_sec, 3),
+        }
+
+    def as_dict(self) -> dict:
+        return {
+            "attempts": self.attempts, "sat": self.sat,
+            "unknown": self.unknown, "time_sec": round(self.time_sec, 3),
+        }
+
+
+#: process-wide statistics (the reference uses a singleton too)
+SOLVER_STATS = SolverStatistics()
 
 
 _INTERESTING = (0, 1, 2, 0xFF, 1 << 31, 1 << 128, M256, M256 - 1, 1 << 255)
@@ -159,7 +208,8 @@ def _assign_leaf(node_id: int, nd, target: int, asn: Assignment) -> bool:
         asn.tx(nd.b).calldatasize = target
         return True
     if kind in (int(FreeKind.STORAGE), int(FreeKind.RETVAL), int(FreeKind.HAVOC),
-                int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH)):
+                int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH),
+                int(FreeKind.ECRECOVER), int(FreeKind.PRECOMPILE)):
         asn.by_node[node_id] = target
         return True
     asn.scalars[(kind, nd.b)] = target
@@ -190,6 +240,14 @@ def _mutate_leaf(tape: HostTape, leaf: int, asn: Assignment, rng: random.Random)
 def solve_tape(tape: HostTape, seed: int = 0, max_iters: int = 400,
                base: Optional[Assignment] = None) -> Optional[Assignment]:
     """Find an assignment satisfying every tape constraint, or None."""
+    t0 = time.perf_counter()
+    out = _solve_tape_inner(tape, seed, max_iters, base)
+    SOLVER_STATS.record(out is not None, time.perf_counter() - t0)
+    return out
+
+
+def _solve_tape_inner(tape: HostTape, seed: int = 0, max_iters: int = 400,
+                      base: Optional[Assignment] = None) -> Optional[Assignment]:
     rng = random.Random(seed)
     asn = base.copy() if base is not None else Assignment()
     vals = evaluate(tape, asn)

@@ -1,0 +1,8 @@
+"""Solidity artifact frontend (reference: ``mythril/solidity/`` ⚠unv)."""
+
+from .soliditycontract import (SolidityContract, SourceMapEntry,
+                               get_contracts_from_standard_json,
+                               parse_srcmap)
+
+__all__ = ["SolidityContract", "SourceMapEntry",
+           "get_contracts_from_standard_json", "parse_srcmap"]

@@ -32,9 +32,9 @@ from jax import lax
 from ..config import LimitsConfig, DEFAULT_LIMITS
 from ..core import interpreter as ci
 from ..core.frontier import (Frontier, Env, Corpus, Trap, CAP_TRAPS,
-                             ATTACKER_ADDRESS)
+                             KILL_TRAPS, ATTACKER_ADDRESS, CODE_UNKNOWN)
 from ..ops import u256
-from .ops import SymOp, FreeKind, TX_STRIDE
+from .ops import SymOp, FreeKind, TX_STRIDE, BAL_STRIDE
 from .state import SymFrontier, SymSpec
 
 I32 = jnp.int32
@@ -73,9 +73,10 @@ def _peek_sym(sf: SymFrontier, i) -> jnp.ndarray:
 
 
 def _set_sym_slot(stack_sym, pos, val, mask):
-    S = stack_sym.shape[1]
-    sel = (jnp.arange(S)[None, :] == pos[:, None]) & mask[:, None]
-    return jnp.where(sel, val[:, None], stack_sym)
+    """Masked scatter (see interpreter._set_slot)."""
+    P, S = stack_sym.shape
+    idx = jnp.where(mask & (pos >= 0), pos, S).astype(I32)
+    return stack_sym.at[jnp.arange(P), idx].set(val, mode="drop")
 
 
 def append_node(sf: SymFrontier, mask, op, a, b, imm=None):
@@ -99,14 +100,15 @@ def append_node(sf: SymFrontier, mask, op, a, b, imm=None):
     hit_id = jnp.argmax(match, axis=1).astype(I32)
     overflow = mask & ~hit & (sf.tape_len >= T)
     write = mask & ~hit & ~overflow
-    onehot = (jnp.arange(T)[None, :] == sf.tape_len[:, None]) & write[:, None]
+    widx = jnp.where(write, jnp.minimum(sf.tape_len, T), T)  # T = dropped
+    lanes = jnp.arange(P)
     ids = jnp.where(mask, jnp.where(hit, hit_id, jnp.where(write, sf.tape_len, 0)), 0)
     return (
         sf.replace(
-            tape_op=jnp.where(onehot, op[:, None], sf.tape_op),
-            tape_a=jnp.where(onehot, a[:, None], sf.tape_a),
-            tape_b=jnp.where(onehot, b[:, None], sf.tape_b),
-            tape_imm=jnp.where(onehot[:, :, None], imm[:, None, :], sf.tape_imm),
+            tape_op=sf.tape_op.at[lanes, widx].set(op, mode="drop"),
+            tape_a=sf.tape_a.at[lanes, widx].set(a, mode="drop"),
+            tape_b=sf.tape_b.at[lanes, widx].set(b, mode="drop"),
+            tape_imm=sf.tape_imm.at[lanes, widx].set(imm, mode="drop"),
             tape_len=sf.tape_len + write.astype(I32),
             base=sf.base.trap(overflow, Trap.TAPE_LIMIT),
         ),
@@ -153,12 +155,13 @@ def _append_constraint(sf: SymFrontier, mask, node, sign, pc):
     C = sf.con_node.shape[1]
     overflow = mask & (sf.con_len >= C)
     write = mask & ~overflow
-    onehot = (jnp.arange(C)[None, :] == sf.con_len[:, None]) & write[:, None]
+    widx = jnp.where(write, jnp.minimum(sf.con_len, C), C)
+    lanes = jnp.arange(mask.shape[0])
     sign = jnp.broadcast_to(jnp.asarray(sign, bool), mask.shape)
     return sf.replace(
-        con_node=jnp.where(onehot, node[:, None], sf.con_node),
-        con_sign=jnp.where(onehot, sign[:, None], sf.con_sign),
-        con_pc=jnp.where(onehot, pc[:, None], sf.con_pc),
+        con_node=sf.con_node.at[lanes, widx].set(node, mode="drop"),
+        con_sign=sf.con_sign.at[lanes, widx].set(sign, mode="drop"),
+        con_pc=sf.con_pc.at[lanes, widx].set(pc, mode="drop"),
         con_len=sf.con_len + write.astype(I32),
         base=sf.base.trap(overflow, Trap.CONSTRAINT_LIMIT),
     )
@@ -197,6 +200,10 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     symm = (key_sym[:, None] != 0) & (sf.st_key_sym == key_sym[:, None])
     match = f.st_used & in_acct & (conc | symm)
     hit = jnp.any(match, axis=1)
+    # dependency tracking: a hit on an entry NOT written this tx is a read
+    # of a prior transaction's write (cache entries only exist via SSTORE)
+    prior_hit = jnp.any(match & ~f.st_written, axis=1)
+    sf = sf.replace(dep_read=sf.dep_read | (m & ~is_store & prior_hit))
     cur = jnp.sum(jnp.where(match[:, :, None], f.st_vals, 0), axis=1).astype(U32)
     cur_sym = jnp.sum(jnp.where(match, sf.st_val_sym, 0), axis=1).astype(I32)
 
@@ -223,7 +230,8 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     # SSTORE into matching-or-free slot (shared alloc policy with the
     # concrete handler)
     slot_id = jnp.argmax(match, axis=1).astype(I32)
-    onehot, overflow = ci.storage_alloc(f, hit, slot_id, m & is_store)
+    widx, overflow = ci.storage_alloc(f, hit, slot_id, m & is_store)
+    lanes = jnp.arange(f.n_lanes)
     # SWC event records: first SSTORE after a RE-ENTERABLE external call
     # (STATICCALL/CREATE can't re-enter mutably), and first SSTORE through
     # a symbolic NON-keccak key (a direct-keccak key is a mapping access;
@@ -241,18 +249,20 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
         base=f.replace(
             stack=stack,
             sp=jnp.where(m & is_store, f.sp - 2, f.sp),
-            st_keys=jnp.where(onehot[:, :, None], key[:, None, :], f.st_keys),
-            st_vals=jnp.where(onehot[:, :, None], val[:, None, :], f.st_vals),
-            st_used=f.st_used | onehot,
-            st_written=f.st_written | onehot,
-            st_acct=jnp.where(onehot, f.cur_acct[:, None], f.st_acct),
+            st_keys=f.st_keys.at[lanes, widx].set(key, mode="drop"),
+            st_vals=f.st_vals.at[lanes, widx].set(val, mode="drop"),
+            st_used=f.st_used.at[lanes, widx].set(True, mode="drop"),
+            st_written=f.st_written.at[lanes, widx].set(True, mode="drop"),
+            st_acct=f.st_acct.at[lanes, widx].set(f.cur_acct, mode="drop"),
         ).trap(overflow, Trap.STORAGE_SLOTS),
         stack_sym=stack_sym,
-        st_key_sym=jnp.where(onehot, key_sym[:, None], sf.st_key_sym),
-        st_val_sym=jnp.where(onehot, val_sym[:, None], sf.st_val_sym),
+        st_key_sym=sf.st_key_sym.at[lanes, widx].set(key_sym, mode="drop"),
+        st_val_sym=sf.st_val_sym.at[lanes, widx].set(val_sym, mode="drop"),
         sstore_after_call_pc=jnp.where(first_after_call, f.pc, sf.sstore_after_call_pc),
+        sstore_ac_cid=jnp.where(first_after_call, f.contract_id, sf.sstore_ac_cid),
         arb_key_node=jnp.where(first_arb, key_sym, sf.arb_key_node),
         arb_key_pc=jnp.where(first_arb, f.pc, sf.arb_key_pc),
+        arb_key_cid=jnp.where(first_arb, f.contract_id, sf.arb_key_cid),
     )
 
 
@@ -315,17 +325,56 @@ def _h_sym_jump(sf: SymFrontier, corpus: Corpus, op, m, old_pc, known, ksign) ->
         ).trap(bad, Trap.BAD_JUMP),
         sym_jump_dest=jnp.where(sym_taken | sym_unres, dest_sym, sf.sym_jump_dest),
         sym_jump_pc=jnp.where(sym_taken | sym_unres, old_pc, sf.sym_jump_pc),
+        sym_jump_cid=jnp.where(sym_taken | sym_unres, f.contract_id, sf.sym_jump_cid),
         fork_req=sf.fork_req | fork_ok,
         fork_dest=jnp.where(fork_ok, dest.astype(I32), sf.fork_dest),
     )
 
 
+def _note_backjump(sf: SymFrontier, mask, dest, loop_bound: int) -> SymFrontier:
+    """Count taken BACKWARD jumps per (lane, contract, target); retire
+    lanes whose revisit count exceeds ``loop_bound``.
+
+    The frontier analog of the reference's ``BoundedLoopsStrategy``
+    (``strategy/extensions/bounded_loops.py`` ⚠unv, SURVEY.md §1 row 7):
+    instead of CFG-cycle counting over a work list, each lane tracks its
+    hottest back-jump targets in a small table; a lane spinning past the
+    bound traps with ``Trap.LOOP_BOUND`` — freeing its slot and its step
+    budget for other paths instead of burning ``max_steps`` for the whole
+    frontier. A miss on a full table reuses the coldest slot (heuristic:
+    the hot loop is by definition the one being revisited)."""
+    if loop_bound <= 0:
+        return sf
+    P, LBS = sf.lb_key.shape
+    key = (sf.base.contract_id * 32768 + dest).astype(I32)
+    live = jnp.arange(LBS)[None, :] < sf.lb_len[:, None]
+    match = live & (sf.lb_key == key[:, None])
+    hit = jnp.any(match, axis=1)
+    hit_slot = jnp.argmax(match, axis=1).astype(I32)
+    has_free = sf.lb_len < LBS
+    cold = jnp.argmin(sf.lb_cnt, axis=1).astype(I32)
+    slot = jnp.where(hit, hit_slot,
+                     jnp.where(has_free, jnp.minimum(sf.lb_len, LBS - 1), cold))
+    cur = jnp.take_along_axis(sf.lb_cnt, slot[:, None], axis=1)[:, 0]
+    cnt = jnp.where(hit, cur + 1, 1)
+    lanes = jnp.arange(P)
+    idx = jnp.where(mask, slot, LBS)
+    return sf.replace(
+        lb_key=sf.lb_key.at[lanes, idx].set(key, mode="drop"),
+        lb_cnt=sf.lb_cnt.at[lanes, idx].set(cnt, mode="drop"),
+        lb_len=sf.lb_len + (mask & ~hit & has_free).astype(I32),
+        base=sf.base.trap(mask & (cnt > loop_bound), Trap.LOOP_BOUND),
+    )
+
+
 def _fr_set(arr, d, val, mask):
-    """arr[P, D, ...]; arr[lane, d[lane]] = val[lane] where mask."""
-    Dn = arr.shape[1]
-    sel = (jnp.arange(Dn)[None, :] == d[:, None]) & mask[:, None]
-    sel = sel.reshape(sel.shape + (1,) * (arr.ndim - 2))
-    return jnp.where(sel, jnp.expand_dims(val, 1), arr)
+    """arr[P, D, ...]; arr[lane, d[lane]] = val[lane] where mask.
+
+    Masked scatter: O(P * elem) instead of the one-hot O(P * D * elem) —
+    this matters most for the [P, D, M] frame memory snapshots."""
+    P, Dn = arr.shape[0], arr.shape[1]
+    idx = jnp.where(mask & (d >= 0), d, Dn).astype(I32)
+    return arr.at[jnp.arange(P), idx].set(val, mode="drop")
 
 
 def _fr_get(arr, d):
@@ -351,11 +400,12 @@ def _record_call_event(sf: SymFrontier, m, op, old_pc, to, to_sym, value,
         call_value_sym=jnp.where(onehot, value_sym[:, None], sf.call_value_sym),
         call_op=jnp.where(onehot, op[:, None], sf.call_op),
         call_pc=jnp.where(onehot, old_pc[:, None], sf.call_pc),
+        call_cid=jnp.where(onehot, sf.base.contract_id[:, None], sf.call_cid),
     )
 
 
 def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
-                limits: LimitsConfig) -> SymFrontier:
+                spec: SymSpec, limits: LimitsConfig) -> SymFrontier:
     """CALL / CALLCODE / DELEGATECALL / STATICCALL with real sub-frames.
 
     Reference: ``call_`` raising TransactionStartSignal + ``call.py``'s
@@ -411,22 +461,34 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
     found, slot = f.acct_lookup(to)
     callee_code = f.acct_field(f.acct_code, slot)
     value_conc = value_sym == 0
+    # precompiles 0x1-0x9 (reference: natives.py dispatch in call.py ⚠unv):
+    # concrete low address, concrete windows; handled without a frame.
+    # Value transfers to precompile addresses are not tracked (documented).
+    hi_zero = jnp.all(to[:, 1:] == 0, axis=1)
+    pid = jnp.where((to_sym == 0) & hi_zero, to[:, 0].astype(I32), 0)
+    RD_cap = f.returndata.shape[1]
+    pre = m & (pid >= 1) & (pid <= 9) & conc_windows & (
+        a_len <= min(M, PRE_IN_CAP))
+    # identity output = input: if it can't fit the returndata buffer the
+    # concrete result would silently truncate — demote to external havoc
+    pre = pre & ~((pid == 4) & (a_len > RD_cap))
     resolvable = (
         m & (to_sym == 0) & found & conc_windows & value_conc
         & (f.depth < D) & (a_len <= CD)
     )
     internal = resolvable & (callee_code >= 0)
-    eoa = resolvable & (callee_code < 0)
-    external = m & ~internal & ~eoa
+    eoa = resolvable & (callee_code == -1)  # CODE_UNKNOWN (-2) -> external
+    external = m & ~internal & ~eoa & ~pre
 
     # memory expansion for the arg/ret windows (charged at call time)
     f = sf.base
-    f, oob_a = ci._expand_memory(f, (internal | eoa) & (a_len > 0), a_off + a_len)
-    f, oob_r = ci._expand_memory(f, (internal | eoa) & (r_len > 0), r_off + r_len)
+    f, oob_a = ci._expand_memory(f, (internal | eoa | pre) & (a_len > 0), a_off + a_len)
+    f, oob_r = ci._expand_memory(f, (internal | eoa | pre) & (r_len > 0), r_off + r_len)
     sf = sf.replace(base=f)
     oob = oob_a | oob_r
     internal = internal & ~oob
     eoa = eoa & ~oob
+    pre = pre & ~oob
 
     # --- value transfer feasibility (concrete value; payer = executing acct)
     payer_bal = f.self_balance
@@ -437,6 +499,9 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
     eoa_ok = eoa & ~insufficient
     # CALLCODE sends value to self (net zero); only plain CALL moves funds
     transfer = (internal_go | eoa_ok) & is_call & wants_value & (slot != f.cur_acct)
+    # rollback snapshot must be PRE-transfer: a reverting value call undoes
+    # the transfer (reference: world-state checkpoint restore ⚠unv)
+    pre_transfer_bal = f.acct_bal
     payee_bal = f.acct_field(f.acct_bal, slot)
     payer_new = u256.sub(payer_bal, value)
     payee_new = u256.add(payee_bal, value)
@@ -446,7 +511,9 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
     acct_bal = jnp.where(payer_oh[:, :, None], payer_new[:, None, :], f.acct_bal)
     acct_bal = jnp.where(payee_oh[:, :, None], payee_new[:, None, :], acct_bal)
     f = f.replace(acct_bal=acct_bal)
-    sf = sf.replace(base=f)
+    # the balance table changed: BALANCE reads after this point must not
+    # share leaves with reads before it
+    sf = sf.replace(base=f, bal_epoch=sf.bal_epoch + transfer.astype(I32))
 
     # --- event record for every path (modules consume this)
     sf = _record_call_event(sf, m, op, old_pc, to.astype(U32), to_sym,
@@ -459,12 +526,23 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
                          jnp.maximum(sf.n_calls - 1, 0))
     f = sf.base
 
+    # DELEGATECALL msg.sender symbol for a top-frame push: the CURRENT
+    # transaction's CALLER leaf — keyed by tx_id like the overlay's top
+    # frame reads, so the delegated code constrains the same symbol the
+    # witness renders (hash-consing dedups onto the seeded tx-0 leaf)
+    deleg_caller = jnp.zeros_like(to_sym)
+    if spec.caller:
+        need_dc = internal_go & is_deleg & (f.depth == 0)
+        sf, deleg_caller = append_node(sf, need_dc, int(SymOp.FREE),
+                                       int(FreeKind.CALLER), sf.tx_id)
+        f = sf.base
+
     # --- push the result word for the non-frame paths
     dest_slot = f.sp - sin
-    m_push = external | eoa_ok | fail0
+    m_push = external | eoa_ok | fail0 | pre
     one_w = jnp.zeros_like(to).at[:, 0].set(1)
     zero_w = jnp.zeros_like(to)
-    res_w = jnp.where(eoa_ok[:, None], one_w, zero_w).astype(U32)
+    res_w = jnp.where((eoa_ok | pre)[:, None], one_w, zero_w).astype(U32)
     stack = ci._set_slot(f.stack, dest_slot, res_w, m_push)
     res_sym = jnp.where(external, rv, 0)
     stack_sym = _set_sym_slot(sf.stack_sym, dest_slot, res_sym, m_push)
@@ -494,7 +572,7 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
         fr_st_used=_fr_set(f.fr_st_used, d, f.st_used, mi),
         fr_st_written=_fr_set(f.fr_st_written, d, f.st_written, mi),
         fr_st_acct=_fr_set(f.fr_st_acct, d, f.st_acct, mi),
-        fr_acct_bal=_fr_set(f.fr_acct_bal, d, f.acct_bal, mi),
+        fr_acct_bal=_fr_set(f.fr_acct_bal, d, pre_transfer_bal, mi),
     )
 
     # callee calldata: bytes from the caller's memory window
@@ -529,6 +607,15 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
         jnp.where(has_value[:, None], value, 0),
     ).astype(U32)
     new_value_sym = jnp.where(is_deleg, sf.callvalue_sym, 0)
+    # a DELEGATECALL frame inherits the caller frame's msg.sender symbol:
+    # at the top frame that is the current tx's CALLER leaf (when
+    # symbolic), deeper it is whatever the frame carried — sender checks
+    # inside delegated code must see the same symbol the top-frame model
+    # exposes
+    eff_caller_sym = sf.caller_sym
+    if spec.caller:
+        eff_caller_sym = jnp.where(f.depth == 0, deleg_caller, eff_caller_sym)
+    new_caller_sym = jnp.where(is_deleg, eff_caller_sym, 0)
     keep_acct = is_deleg | (op == 0xF2)  # DELEGATECALL/CALLCODE keep storage ctx
 
     f2 = f2.replace(
@@ -549,7 +636,7 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
         returndata_len=jnp.where(mi | m_push, 0, f2.returndata_len),
         stack=stack,
     )
-    return sf.replace(
+    sf = sf.replace(
         base=f2,
         stack_sym=stack_sym,
         mem_sym=jnp.where(mi[:, None], 0, sf.mem_sym),
@@ -560,6 +647,8 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
         cd_havoc=jnp.where(mi, cd_havoc_new, sf.cd_havoc),
         cd_sym=jnp.where(mi[:, None], cd_sym_new, sf.cd_sym),
         callvalue_sym=jnp.where(mi, new_value_sym, sf.callvalue_sym),
+        caller_sym=jnp.where(mi, new_caller_sym, sf.caller_sym),
+        fr_caller_sym=_fr_set(sf.fr_caller_sym, d, sf.caller_sym, mi),
         fr_mem_sym=_fr_set(sf.fr_mem_sym, d, sf.mem_sym, mi),
         fr_mem_havoc=_fr_set(sf.fr_mem_havoc, d, sf.mem_havoc, mi),
         fr_cd_from_mem=_fr_set(sf.fr_cd_from_mem, d, sf.cd_from_mem, mi),
@@ -569,14 +658,181 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
         fr_st_val_sym=_fr_set(sf.fr_st_val_sym, d, sf.st_val_sym, mi),
         fr_st_key_sym=_fr_set(sf.fr_st_key_sym, d, sf.st_key_sym, mi),
     )
+    # precompile outputs land after the common bookkeeping so they can
+    # override the pushed-result defaults for their lanes
+    return lax.cond(
+        jnp.any(pre),
+        lambda s: _apply_precompiles(s, pre, pid, a_off, a_len, r_off, r_len),
+        lambda s: s,
+        sf,
+    )
+
+
+CREATE_ADDR_BASE = 0xC0DE00000000  # fresh pseudo-addresses for CREATE results
+
+
+PRE_IN_CAP = 320  # precompile input window cap (modexp header + 3x32-byte
+# operands = 192; sha256/identity accept up to this; longer inputs fall to
+# the external-havoc path, counted like any unresolved call)
+
+
+def _be_window_word(buf, start, width, INW: int):
+    """u256 word from `width[P]` big-endian bytes at `start[P]` of buf[P,INW]
+    (right-aligned: value = int.from_bytes(buf[start:start+width]))."""
+    I = jnp.int64
+    s = start.astype(I) + width.astype(I) - 32
+    raw = ci._gather_bytes(buf, s, 32, jnp.full_like(s, INW))
+    k = jnp.arange(32)[None, :]
+    valid = (s[:, None] + k) >= start[:, None].astype(I)
+    return ci._be_bytes_to_word(jnp.where(valid, raw, 0))
+
+
+def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
+                       r_len) -> SymFrontier:
+    """Execute precompile calls 0x1-0x9 for the `pre` lanes.
+
+    Reference: ``mythril/laser/ethereum/natives.py`` (⚠unv). Modeled:
+
+    - 0x2 sha256: device kernel on concrete input;
+    - 0x4 identity: byte copy;
+    - 0x5 modexp: device square-and-multiply for <= 32-byte operands;
+    - 0x1 ecrecover: uninterpreted ECRECOVER leaf per call site (the
+      reference models the symbolic case the same way; no secp256k1 on
+      device — concrete recovery is not computed, documented);
+    - 0x3 ripemd160, 0x6-0x8 bn128, 0x9 blake2f: fresh PRECOMPILE leaf
+      (sound havoc).
+
+    Symbolic input bytes demote the concrete cases (2/4/5) to the leaf
+    path. Success is always pushed by the caller; gas for precompiles is
+    not charged (static min/max tables only — documented).
+    """
+    f = sf.base
+    P, M = f.memory.shape
+    RD = f.returndata.shape[1]
+    INW = min(M, PRE_IN_CAP)  # static input gather width (pre <= this)
+    W = sf.mem_sym.shape[1]
+
+    wids = jnp.arange(W)[None, :]
+    win_lo = (a_off // 32)[:, None]
+    win_hi = ((a_off + a_len + 31) // 32)[:, None]
+    sym_in = (sf.mem_havoc | jnp.any(
+        (wids >= win_lo) & (wids < win_hi) & (sf.mem_sym != 0), axis=1
+    )) & (a_len > 0)
+
+    inp = ci._gather_bytes(f.memory, a_off, INW, jnp.full_like(a_off, M))
+    inp = jnp.where(jnp.arange(INW)[None, :] < a_len[:, None], inp, 0)
+
+    conc = pre & ~sym_in
+    m_sha = conc & (pid == 2)
+    m_id = conc & (pid == 4)
+
+    # modexp header: three 32-byte big-endian lengths
+    blen = u256.to_u64_saturating(ci._be_bytes_to_word(inp[:, 0:32])).astype(I64)
+    elen = u256.to_u64_saturating(ci._be_bytes_to_word(inp[:, 32:64])).astype(I64)
+    mlen = u256.to_u64_saturating(ci._be_bytes_to_word(inp[:, 64:96])).astype(I64)
+    # the u64->i64 cast can wrap huge headers negative — a negative length
+    # must NOT pass the <=32 window check (it would read garbage offsets)
+    fits = ((blen >= 0) & (blen <= 32) & (elen >= 0) & (elen <= 32)
+            & (mlen >= 0) & (mlen <= 32)
+            & (96 + blen + elen + mlen <= a_len))
+    m_mod = conc & (pid == 5) & fits
+    m_leaf = pre & ~m_sha & ~m_id & ~m_mod
+
+    from ..ops.sha256 import sha256_device
+    sha_w = lax.cond(
+        jnp.any(m_sha),
+        lambda: sha256_device(inp, jnp.clip(a_len, 0, INW).astype(I32)),
+        lambda: jnp.zeros((P, 8), dtype=U32),
+    )
+    mod_w = lax.cond(
+        jnp.any(m_mod),
+        lambda: u256.modexp(
+            _be_window_word(inp, jnp.full_like(blen, 96), blen, INW),
+            _be_window_word(inp, 96 + blen, elen, INW),
+            _be_window_word(inp, 96 + blen + elen, mlen, INW),
+        ),
+        lambda: jnp.zeros((P, 8), dtype=U32),
+    )
+
+    # leaf result node (hash-consed per call site via the call index)
+    kind = jnp.where(pid == 1, int(FreeKind.ECRECOVER), int(FreeKind.PRECOMPILE))
+    sf, leaf = append_node(sf, m_leaf, int(SymOp.FREE), kind,
+                           jnp.maximum(sf.n_calls - 1, 0))
+    f = sf.base
+
+    # output byte image (concrete cases) + logical output length
+    out_len = jnp.where(pid == 4, jnp.minimum(a_len, RD),
+                        jnp.where(pid == 5, mlen,
+                                  jnp.where((pid == 6) | (pid == 7) | (pid == 9),
+                                            64, 32))).astype(I64)
+    out = jnp.where(m_id[:, None], inp[:, :RD] if INW >= RD else
+                    jnp.pad(inp, ((0, 0), (0, RD - INW))), 0).astype(jnp.uint8)
+    sha_bytes = ci._word_to_be_bytes(sha_w)  # u8[P,32]
+    mod_be = ci._word_to_be_bytes(mod_w)
+    # modexp output is the result right-aligned in mlen bytes
+    kk = jnp.arange(RD, dtype=I64)[None, :]
+    mod_src = jnp.clip(32 - mlen[:, None] + kk, 0, 31).astype(I32)
+    mod_bytes = jnp.take_along_axis(
+        jnp.pad(mod_be, ((0, 0), (0, max(0, RD - 32)))),
+        jnp.minimum(mod_src, 31), axis=1)
+    head = kk < 32
+    out = jnp.where((m_sha[:, None] & head),
+                    jnp.pad(sha_bytes, ((0, 0), (0, max(0, RD - 32)))), out)
+    out = jnp.where(m_mod[:, None] & (kk < mlen[:, None]), mod_bytes, out)
+
+    # returndata buffer + memory window write
+    conc_res = m_sha | m_id | m_mod
+    n_out = jnp.clip(out_len, 0, RD).astype(I32)
+    returndata = jnp.where(pre[:, None], out, f.returndata)
+    returndata = jnp.where(
+        pre[:, None] & (jnp.arange(RD)[None, :] >= n_out[:, None]), 0, returndata
+    ).astype(jnp.uint8)
+    n_mem = jnp.minimum(out_len, r_len)
+    jpos = jnp.arange(M, dtype=I64)[None, :]
+    in_win = (jpos >= r_off[:, None]) & (jpos < (r_off + n_mem)[:, None])
+    src = ci._take_per_lane(out, jpos - r_off[:, None], n_mem)
+    memory = jnp.where(in_win & conc_res[:, None], src, f.memory).astype(jnp.uint8)
+
+    # sym overlay of the output window: concrete results clear covered
+    # words (edge words with stale syms -> havoc); leaf results plant the
+    # leaf on a single aligned word, anything wider/unaligned havocs
+    full_lo = ((r_off + 31) // 32)[:, None]
+    full_hi = ((r_off + n_mem) // 32)[:, None]
+    covered = (wids >= full_lo) & (wids < full_hi) & conc_res[:, None]
+    mem_sym = jnp.where(covered, 0, sf.mem_sym)
+    edge = (((wids == (r_off // 32)[:, None]) | (wids == full_hi))
+            & ~covered & conc_res[:, None] & (n_mem[:, None] > 0))
+    edge_dirty = jnp.any(edge & (sf.mem_sym != 0), axis=1)
+    leaf_word_ok = m_leaf & ((r_off % 32) == 0) & (r_len >= 32) & (out_len == 32)
+    mem_sym = _set_word_sym(mem_sym, (r_off // 32).astype(I32), leaf, leaf_word_ok)
+    mem_havoc = sf.mem_havoc | (conc_res & edge_dirty) | (
+        m_leaf & (r_len > 0) & ~leaf_word_ok
+    )
+
+    return sf.replace(
+        base=f.replace(memory=memory, returndata=returndata,
+                       returndata_len=jnp.where(pre, n_out, f.returndata_len)),
+        mem_sym=mem_sym,
+        mem_havoc=mem_havoc,
+        retdata_sym=jnp.where(pre, m_leaf, sf.retdata_sym),
+    )
 
 
 def _h_sym_create(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
-    """CREATE/CREATE2: record the event, push a havoc address (init-code
-    execution is a documented gap — creation TRANSACTIONS are modeled at
-    the analysis-wrapper level instead; reference: ``create_`` spawning a
-    ContractCreationTransaction ⚠unv)."""
+    """CREATE/CREATE2: value transfer + a FRESH codeless account.
+
+    The init code is not executed in-frame (documented over-approximation:
+    the created account's code is unknown to the engine, so later calls to
+    it take the external-havoc path — never a wrong value). Top-level
+    creation TRANSACTIONS are fully modeled by the analysis wrapper
+    (``SymExecWrapper`` creation mode; reference: ``create_`` spawning a
+    ContractCreationTransaction ⚠unv). The pushed result is a
+    deterministic fresh address per (lane, create index) — concrete and
+    unaliased with corpus accounts (CREATE2's keccak address identity is
+    not modeled; the address is fresh either way).
+    """
     f = sf.base
+    P = f.n_lanes
     static_viol = m & f.static
     sf = sf.replace(base=f.trap(static_viol, Trap.STATIC_WRITE))
     f = sf.base
@@ -590,18 +846,55 @@ def _h_sym_create(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
     sf = sf.replace(base=f)
     sf = _record_call_event(sf, m, op, old_pc, jnp.zeros_like(value).astype(U32),
                             jnp.zeros_like(value_sym), value.astype(U32), value_sym)
-    sf, rv = append_node(sf, m, int(SymOp.FREE), int(FreeKind.RETVAL),
-                         jnp.maximum(sf.n_calls - 1, 0))
     f = sf.base
+
+    # concrete-value feasibility (symbolic value: no transfer modeled, the
+    # fresh address is still pushed — the RETVAL of a create is its address)
+    value_conc = value_sym == 0
+    wants = m & value_conc & ~u256.is_zero(value)
+    payer_bal = f.self_balance
+    insufficient = wants & u256.lt(payer_bal, value)
+    ok = m & ~insufficient
+
+    # register the new account in a free slot; a full table just skips
+    # registration (the pushed address then resolves nowhere -> external)
+    A = f.acct_used.shape[1]
+    free = ~f.acct_used
+    has_free = jnp.any(free, axis=1)
+    slot = jnp.argmax(free, axis=1).astype(I32)
+    reg = ok & has_free
+    addr_w = u256.from_u64_scalar(
+        jnp.uint64(CREATE_ADDR_BASE) + sf.create_cnt.astype(jnp.uint64))
+    lanes = jnp.arange(P)
+    sidx = jnp.where(reg, slot, A)
+    acct_addr = f.acct_addr.at[lanes, sidx].set(addr_w, mode="drop")
+    init_bal = jnp.where((wants & ~insufficient)[:, None], value, 0).astype(U32)
+    acct_bal = f.acct_bal.at[lanes, sidx].set(init_bal, mode="drop")
+    # CODE_UNKNOWN, not EOA: the created contract HAS code (the init
+    # code's dynamic result) — calls must havoc, never succeed concretely
+    acct_code = f.acct_code.at[lanes, sidx].set(CODE_UNKNOWN, mode="drop")
+    acct_used = f.acct_used.at[lanes, sidx].set(True, mode="drop")
+    # deduct the payer (only when the endowment actually moved)
+    pay_idx = jnp.where(reg & wants, f.cur_acct, A)
+    acct_bal = acct_bal.at[lanes, pay_idx].set(
+        u256.sub(payer_bal, value), mode="drop")
+
     dest_slot = f.sp - sin
-    stack = ci._set_slot(f.stack, dest_slot, jnp.zeros_like(value), m)
+    res_w = jnp.where(ok[:, None], addr_w, 0).astype(U32)
+    stack = ci._set_slot(f.stack, dest_slot, res_w, m)
     return sf.replace(
         base=f.replace(
             stack=stack,
             sp=jnp.where(m, f.sp - sin + 1, f.sp),
             returndata_len=jnp.where(m, 0, f.returndata_len),
+            acct_addr=acct_addr, acct_bal=acct_bal,
+            acct_code=acct_code, acct_used=acct_used,
         ),
-        stack_sym=_set_sym_slot(sf.stack_sym, dest_slot, rv, m),
+        stack_sym=_set_sym_slot(sf.stack_sym, dest_slot,
+                                jnp.zeros((P,), I32), m),
+        retdata_sym=jnp.where(m, False, sf.retdata_sym),
+        create_cnt=sf.create_cnt + m.astype(I32),
+        bal_epoch=sf.bal_epoch + (reg & wants).astype(I32),
     )
 
 
@@ -617,10 +910,10 @@ def pop_frames(sf: SymFrontier) -> SymFrontier:
     """
     f = sf.base
     ended = f.active & (f.depth > 0) & (f.halted | f.error)
-    is_cap = jnp.zeros_like(f.error)
-    for c in CAP_TRAPS:
-        is_cap = is_cap | (f.err_code == c)
-    mp = ended & ~(f.error & is_cap)
+    is_kill = jnp.zeros_like(f.error)
+    for c in KILL_TRAPS:
+        is_kill = is_kill | (f.err_code == c)
+    mp = ended & ~(f.error & is_kill)
     success = mp & f.halted & ~f.reverted & ~f.error
     fail = mp & (f.error | f.reverted)
     d = jnp.maximum(f.depth - 1, 0)
@@ -730,10 +1023,20 @@ def pop_frames(sf: SymFrontier) -> SymFrontier:
         cd_havoc=jnp.where(mp, _fr_get(sf.fr_cd_havoc, d), sf.cd_havoc),
         cd_sym=jnp.where(mp[:, None], _fr_get(sf.fr_cd_sym, d), sf.cd_sym),
         callvalue_sym=jnp.where(mp, _fr_get(sf.fr_callvalue_sym, d), sf.callvalue_sym),
+        caller_sym=jnp.where(mp, _fr_get(sf.fr_caller_sym, d), sf.caller_sym),
+        # a failed value call rolled the balance table back — another change
+        bal_epoch=sf.bal_epoch + fail.astype(I32),
         st_val_sym=st_val_sym,
         st_key_sym=st_key_sym,
-        sub_revert_pc=jnp.where(fail & (sf.sub_revert_pc < 0), ret_pc,
+        # only a genuine REVERT (require()-style) feeds SWC-123; callee
+        # INVALID/OOG/bad-jump are assert-style failures (SWC-110 territory)
+        sub_revert_pc=jnp.where(fail & f.reverted & ~f.error
+                                & (sf.sub_revert_pc < 0), ret_pc,
                                 sf.sub_revert_pc),
+        sub_revert_cid=jnp.where(fail & f.reverted & ~f.error
+                                 & (sf.sub_revert_pc < 0),
+                                 _fr_get(f.fr_contract_id, d),
+                                 sf.sub_revert_cid),
     )
 
 
@@ -744,6 +1047,12 @@ def _h_sym_claimed_misc(sf: SymFrontier, op, m_memoff, m_sha3off, m_copyoff,
     addresses under static shapes)."""
     f = sf.base
     is_load = op == 0x51
+    # LOG is a state modification: a symbolic-offset LOG inside a
+    # STATICCALL frame must trap exactly like the concrete handler's
+    static_viol = m_logoff & f.static
+    m_logoff = m_logoff & ~static_viol
+    sf = sf.replace(base=f.trap(static_viol, Trap.STATIC_WRITE))
+    f = sf.base
     any_m = m_memoff | m_sha3off | m_copyoff | m_haltoff | m_logoff
 
     # MLOAD(sym off) / SHA3(sym args) -> fresh havoc result
@@ -760,6 +1069,14 @@ def _h_sym_claimed_misc(sf: SymFrontier, op, m_memoff, m_sha3off, m_copyoff,
     d_sp = sin - sout
     is_revert = op == 0xFD
     has_data_halt = (op == 0xF3) | is_revert
+    # symbolic-offset LOG: still record pc/cid/topic0 (topics may be
+    # concrete even when the data window is not); payload word unknown (-1)
+    LS = f.log_pc.shape[1]
+    lanes = jnp.arange(f.pc.shape[0])
+    wl = jnp.where(m_logoff & (f.n_logs < LS),
+                   jnp.minimum(f.n_logs, LS - 1), LS)
+    n_topics = op.astype(I32) - 0xA0
+    topic0 = ci._peek(f, 2)
     return sf.replace(
         base=f.replace(
             sp=jnp.where(any_m, f.sp - d_sp, f.sp),
@@ -767,7 +1084,16 @@ def _h_sym_claimed_misc(sf: SymFrontier, op, m_memoff, m_sha3off, m_copyoff,
             reverted=f.reverted | (m_haltoff & is_revert),
             retval_len=jnp.where(m_haltoff, 0, f.retval_len),
             n_logs=f.n_logs + m_logoff.astype(I32),
+            log_pc=f.log_pc.at[lanes, wl].set(f.pc, mode="drop"),
+            log_cid=f.log_cid.at[lanes, wl].set(f.contract_id, mode="drop"),
+            log_ntopics=f.log_ntopics.at[lanes, wl].set(n_topics, mode="drop"),
+            log_topic0=f.log_topic0.at[lanes, wl].set(
+                jnp.where((n_topics >= 1)[:, None], topic0, 0).astype(
+                    jnp.uint32), mode="drop"),
         ),
+        log_topic0_sym=sf.log_topic0_sym.at[lanes, wl].set(
+            jnp.where(n_topics >= 1, _peek_sym(sf, 2), 0), mode="drop"),
+        log_data0_sym=sf.log_data0_sym.at[lanes, wl].set(-1, mode="drop"),
         stack_sym=stack_sym,
         # symbolic-offset stores / copies invalidate the whole memory overlay
         mem_havoc=sf.mem_havoc | (m_memoff & ~is_load) | m_copyoff,
@@ -788,9 +1114,9 @@ def _take_word_sym(mem_sym, w):
 
 
 def _set_word_sym(mem_sym, w, val, mask):
-    W = mem_sym.shape[1]
-    sel = (jnp.arange(W)[None, :] == w[:, None]) & mask[:, None] & (w[:, None] < W) & (w[:, None] >= 0)
-    return jnp.where(sel, val[:, None], mem_sym)
+    P, W = mem_sym.shape
+    idx = jnp.where(mask & (w >= 0) & (w < W), w, W).astype(I32)
+    return mem_sym.at[jnp.arange(P), idx].set(val, mode="drop")
 
 
 def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
@@ -852,6 +1178,7 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
         arith_b=jnp.where(ar_onehot, bid[:, None], sf.arith_b),
         arith_r=jnp.where(ar_onehot, r_bin[:, None], sf.arith_r),
         arith_pc=jnp.where(ar_onehot, old_pc_arr[:, None], sf.arith_pc),
+        arith_cid=jnp.where(ar_onehot, sf.base.contract_id[:, None], sf.arith_cid),
     )
 
     # ---- CLS_MODARITH: symbolic addmod/mulmod -> havoc (documented) ----
@@ -893,15 +1220,19 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     leaf(spec.block_env, op == 0x43, int(FreeKind.NUMBER), 0)
     leaf(spec.block_env, op == 0x44, int(FreeKind.PREVRANDAO), 0)
     leaf(spec.block_env, op == 0x3A, int(FreeKind.GASPRICE), 0)
-    # balances: a symbolic leaf per ACCOUNT SLOT (b = slot) — balances
-    # change under symbolic value transfers, so a concrete table read
-    # could be wrong; known accounts share one leaf per slot, unknown
-    # addresses havoc below
+    # balances: a symbolic leaf per (epoch, ACCOUNT SLOT) — balances change
+    # under symbolic value transfers, so a concrete table read could be
+    # wrong; known accounts share one leaf per slot WITHIN an epoch, and
+    # the epoch bumps whenever the concrete table changes (transfer /
+    # rollback / tx boundary) so pre/post reads are not forced equal
     is_balance = op == 0x31
     known_acct, acct_slot = sf.base.acct_lookup(a[0])
     known_bal = is_balance & known_acct & (s[0] == 0)
-    leaf(spec.block_env, op == 0x47, int(FreeKind.BALANCE), sf.base.cur_acct)
-    leaf(spec.block_env, known_bal, int(FreeKind.BALANCE), acct_slot)
+    epoch_b = sf.bal_epoch * BAL_STRIDE
+    leaf(spec.block_env, op == 0x47, int(FreeKind.BALANCE),
+         epoch_b + sf.base.cur_acct)
+    leaf(spec.block_env, known_bal, int(FreeKind.BALANCE),
+         epoch_b + acct_slot)
     # RETURNDATASIZE after a symbolic call
     leaf(True, (op == 0x3D) & sf.retdata_sym, int(FreeKind.RETDATASIZE),
          jnp.maximum(sf.n_calls - 1, 0))
@@ -915,11 +1246,21 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     # EXTCODESIZE of a table account is answered concretely by the
     # concrete handler; EXTCODEHASH stays unknowable (no hash modeled).
     unknown_addr = (s[0] != 0) | ~known_acct
+    # a table account whose CODE is unknown (CREATE result): size/bytes
+    # must havoc, never read as the concrete 0/zeros the table yields
+    code_unknown = known_acct & (
+        sf.base.acct_field(sf.base.acct_code, acct_slot) == CODE_UNKNOWN
+    )
+    # a concrete-offset CALLDATALOAD past the modeled window would read a
+    # silent concrete 0 even though CALLDATASIZE is symbolic beyond it —
+    # havoc instead (the engine's own policy: never a wrong value)
+    cd_beyond_window = bool(spec.calldata) & is_cdload & (s[0] == 0) & beyond & at_top
     env_hv_need = m_env & (
         (is_cdload & (s[0] != 0))
+        | cd_beyond_window
         | (is_balance & unknown_addr)
         | (op == 0x40)  # BLOCKHASH
-        | ((op == 0x3B) & unknown_addr)
+        | ((op == 0x3B) & (unknown_addr | code_unknown))
         | (op == 0x3F)  # EXTCODEHASH
     )
     # sub-frame CALLVALUE / CALLDATALOAD: values flow from the caller's
@@ -943,8 +1284,13 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
 
     env_hv_need = env_hv_need | hv_cd_need
     sf, env_hv = _havoc(sf, env_hv_need)
+    # sub-frame CALLER: a DELEGATECALL frame carries the caller frame's
+    # msg.sender symbol (advisor r2: sender checks inside delegated code
+    # must not be decided concretely while the top-frame model is symbolic)
+    cl_sub = m_env & (op == 0x33) & sub & (sf.caller_sym != 0)
     r_env = jnp.where(need_leaf, env_leaf, 0)
     r_env = jnp.where(cv_sub, sf.callvalue_sym, r_env)
+    r_env = jnp.where(cl_sub, sf.caller_sym, r_env)
     r_env = jnp.where(cd_sub & cd_al & ~sf.cd_havoc, cda, r_env)
     r_env = jnp.where(env_hv_need, env_hv, r_env)
     # "executed ORIGIN" flag (DeprecatedOperations SWC-111): the leaf node
@@ -1045,8 +1391,12 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     )
     # concrete-source copies (code/extcode/concrete returndata): fully
     # covered words become concrete; partial edge words with stale syms ->
-    # havoc flag
-    conc_src = m_cp & ~is_cdcopy & ~(is_rdcopy & sf.retdata_sym) & (cln64 > 0)
+    # havoc flag. EXTCODECOPY of an unknown-code account (CREATE result)
+    # is NOT a concrete source — the zeros the concrete handler wrote are
+    # wrong, so the window havocs instead.
+    ext_unknown = is_ext & code_unknown
+    conc_src = (m_cp & ~is_cdcopy & ~(is_rdcopy & sf.retdata_sym)
+                & (cln64 > 0) & ~ext_unknown)
     W = sf.mem_sym.shape[1]
     wids = jnp.arange(W)[None, :]
     full_lo = ((dst64 + 31) // 32)[:, None]
@@ -1059,7 +1409,8 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     edge_dirty = jnp.any(edge & (sf.mem_sym != 0), axis=1)
     sf = sf.replace(
         mem_sym=mem_sym2,
-        mem_havoc=sf.mem_havoc | cd_havoc | (conc_src & edge_dirty),
+        mem_havoc=sf.mem_havoc | cd_havoc | (conc_src & edge_dirty)
+        | (m_cp & ext_unknown & (cln64 > 0)),
     )
 
     # ---- CLS_HALT: capture return-payload syms; SELFDESTRUCT beneficiary ----
@@ -1082,7 +1433,24 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
         sd_to_sym=jnp.where(m_halt & is_sd, s[0], sf.sd_to_sym),
         sd_to=jnp.where((m_halt & is_sd)[:, None], a[0], sf.sd_to).astype(U32),
         sd_pc=jnp.where(first_sd, sf.base.pc, sf.sd_pc),
+        sd_cid=jnp.where(first_sd, sf.base.contract_id, sf.sd_cid),
         inv_pc=jnp.where(first_inv, sf.base.pc, sf.inv_pc),
+        inv_cid=jnp.where(first_inv, sf.base.contract_id, sf.inv_cid),
+    )
+
+    # ---- CLS_LOG: sym overlay of the record the concrete handler wrote ----
+    m_log = m & (cls == ci.CLS_LOG)
+    LS = sf.base.log_pc.shape[1]
+    log_idx = sf.base.n_logs - 1  # concrete handler already bumped it
+    wl = jnp.where(m_log & (log_idx >= 0) & (log_idx < LS), log_idx, LS)
+    lanes_all = jnp.arange(f.pc.shape[0])
+    d0_sym = jnp.where(aligned & ~sf.mem_havoc, wsym_a, -1)
+    d0_sym = jnp.where(u256.to_u64_saturating(a[1]) == 0, 0, d0_sym)
+    log_nt = op - 0xA0  # LOG0 has no topic: s[2] is an unrelated slot
+    sf = sf.replace(
+        log_topic0_sym=sf.log_topic0_sym.at[lanes_all, wl].set(
+            jnp.where(log_nt >= 1, s[2], 0), mode="drop"),
+        log_data0_sym=sf.log_data0_sym.at[lanes_all, wl].set(d0_sym, mode="drop"),
     )
 
     # ---- write result syms into the result slot (clears stale ids) ----
@@ -1157,13 +1525,21 @@ def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
     sf = _cond_apply(sf, claim_jump,
                      lambda x: _h_sym_jump(x, corpus, op, claim_jump, old_pc, known, ksign))
     sf = _cond_apply(sf, claim_call,
-                     lambda x: _h_sym_call(x, corpus, op, claim_call, old_pc, limits))
+                     lambda x: _h_sym_call(x, corpus, op, claim_call, old_pc,
+                                           spec, limits))
     sf = _cond_apply(sf, claim_create,
                      lambda x: _h_sym_create(x, op, claim_create, old_pc))
     misc = claim_memoff | claim_sha3off | claim_copyoff | claim_haltoff | claim_logoff
     sf = _cond_apply(sf, misc,
                      lambda x: _h_sym_claimed_misc(x, op, claim_memoff, claim_sha3off,
                                                    claim_copyoff, claim_haltoff, claim_logoff))
+
+    # bounded loops: any jump that landed at-or-before its own pc (the
+    # fork-taken copies are counted in expand_forks)
+    fb = sf.base
+    back = (run & (cls == ci.CLS_JUMP) & ~fb.halted & ~fb.error
+            & (fb.pc <= old_pc))
+    sf = _note_backjump(sf, back, fb.pc, limits.loop_bound)
 
     f = ci.epilogue(sf.base, op, run, old_pc)
     sf = sf.replace(base=f)
@@ -1173,7 +1549,10 @@ def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
     return lax.cond(any_ended, pop_frames, lambda x: x, sf)
 
 
-def between_txs(sf: SymFrontier) -> SymFrontier:
+def between_txs(sf: SymFrontier, require_mutation: bool = True,
+                new_contract_id=None,
+                dependency_prune: bool = True,
+                first_message_tx: int = 0) -> SymFrontier:
     """Advance surviving lanes to the next symbolic transaction.
 
     Counterpart of the reference's ``open_states`` handoff
@@ -1188,11 +1567,33 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
     per-transaction and reset — the per-tx context snapshots taken by
     ``SymExecWrapper`` already preserved them for detection.
     tx-scoped leaves re-key via tx_id (TX_STRIDE encoding).
+
+    ``require_mutation=False`` + ``new_contract_id`` serve the
+    creation→runtime handoff (reference: ``execute_contract_creation``
+    then message calls ⚠unv): a constructor needn't write storage for its
+    deploy to count, and the surviving lanes switch from the creation
+    image to the runtime image while keeping their storage.
     """
     b = sf.base
     P = sf.n_lanes
-    mutated = jnp.any(b.st_written, axis=1)
-    go = b.active & b.halted & ~b.error & ~b.reverted & ~b.selfdestructed & mutated
+    go = b.active & b.halted & ~b.error & ~b.reverted & ~b.selfdestructed
+    if require_mutation:
+        go = go & jnp.any(b.st_written, axis=1)
+    if dependency_prune:
+        # DependencyPruner (reference: ``plugins/dependency_pruner.py``
+        # ⚠unv, SURVEY §5.7 "the single biggest algorithmic speedup"): a
+        # later message-call path that read nothing any prior tx wrote
+        # behaved exactly like an earlier message call from the same state
+        # — its issues were already collected in this tx's snapshot, so it
+        # retires instead of spawning redundant deeper exploration. The
+        # FIRST message call is exempt (``first_message_tx`` shifts by one
+        # when a creation tx ran: the constructor is different code, not
+        # an equivalent ancestor).
+        go = go & ((sf.tx_id <= first_message_tx) | sf.dep_read)
+    if new_contract_id is None:
+        new_home = b.home_contract
+    else:
+        new_home = jnp.asarray(new_contract_id, dtype=b.home_contract.dtype)
     attacker = jnp.broadcast_to(
         jnp.asarray(u256.from_int(ATTACKER_ADDRESS)), (P, 8)
     ).astype(jnp.uint32)
@@ -1209,7 +1610,8 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
             sp_base=jnp.where(go, 0, b.sp_base),
             static=jnp.where(go, False, b.static),
             cur_acct=jnp.where(go, b.home_acct, b.cur_acct),
-            contract_id=jnp.where(go, b.home_contract, b.contract_id),
+            home_contract=jnp.where(go, new_home, b.home_contract),
+            contract_id=jnp.where(go, new_home, b.contract_id),
             caller_addr=jnp.where(go[:, None], attacker, b.caller_addr),
             callvalue=jnp.where(go[:, None], 0, b.callvalue).astype(jnp.uint32),
             memory=jnp.where(go[:, None], 0, b.memory),
@@ -1220,6 +1622,11 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
             returndata_len=jnp.where(go, 0, b.returndata_len),
             retval_len=jnp.where(go, 0, b.retval_len),
             n_logs=jnp.where(go, 0, b.n_logs),
+            log_pc=jnp.where(go[:, None], 0, b.log_pc),
+            log_cid=jnp.where(go[:, None], 0, b.log_cid),
+            log_ntopics=jnp.where(go[:, None], 0, b.log_ntopics),
+            log_topic0=jnp.where(go[:, None, None], 0, b.log_topic0),
+            log_data0=jnp.where(go[:, None, None], 0, b.log_data0),
             st_written=jnp.where(go[:, None], False, b.st_written),
         ),
         stack_sym=jnp.where(go[:, None], 0, sf.stack_sym),
@@ -1232,13 +1639,18 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         cd_havoc=jnp.where(go, False, sf.cd_havoc),
         cd_sym=jnp.where(go[:, None], 0, sf.cd_sym),
         callvalue_sym=jnp.where(go, 0, sf.callvalue_sym),
+        caller_sym=jnp.where(go, 0, sf.caller_sym),
+        # new tx: the (symbolic) incoming callvalue changes balances again
+        bal_epoch=sf.bal_epoch + go.astype(I32),
         sub_revert_pc=jnp.where(go, -1, sf.sub_revert_pc),
+        sub_revert_cid=jnp.where(go, 0, sf.sub_revert_cid),
         tx_id=jnp.where(go, sf.tx_id + 1, sf.tx_id),
         # per-tx one-shot event records reset so tx N+1 can't inherit
         # tx N's calls/arith/SSTORE-after-call evidence (the per-tx
         # snapshot consumed them already)
         sym_jump_dest=jnp.where(go, 0, sf.sym_jump_dest),
         sym_jump_pc=jnp.where(go, -1, sf.sym_jump_pc),
+        sym_jump_cid=jnp.where(go, 0, sf.sym_jump_cid),
         # the saturation counters reset for EVERY lane (not just survivors):
         # coverage_summary sums them across tx snapshots, and a retired
         # lane's stale count would be recounted each remaining tx
@@ -1250,11 +1662,17 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         call_value=jnp.where(go[:, None, None], 0, sf.call_value),
         call_value_sym=jnp.where(go[:, None], 0, sf.call_value_sym),
         call_pc=jnp.where(go[:, None], 0, sf.call_pc),
+        call_cid=jnp.where(go[:, None], 0, sf.call_cid),
+        log_topic0_sym=jnp.where(go[:, None], 0, sf.log_topic0_sym),
+        log_data0_sym=jnp.where(go[:, None], 0, sf.log_data0_sym),
         origin_read=jnp.where(go, False, sf.origin_read),
         inv_pc=jnp.where(go, -1, sf.inv_pc),
+        inv_cid=jnp.where(go, 0, sf.inv_cid),
         sstore_after_call_pc=jnp.where(go, -1, sf.sstore_after_call_pc),
+        sstore_ac_cid=jnp.where(go, 0, sf.sstore_ac_cid),
         arb_key_node=jnp.where(go, 0, sf.arb_key_node),
         arb_key_pc=jnp.where(go, -1, sf.arb_key_pc),
+        arb_key_cid=jnp.where(go, 0, sf.arb_key_cid),
         dropped_forks=jnp.zeros_like(sf.dropped_forks),
         n_arith=jnp.zeros_like(sf.n_arith),
         arith_op=jnp.where(go[:, None], 0, sf.arith_op),
@@ -1262,6 +1680,7 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         arith_b=jnp.where(go[:, None], 0, sf.arith_b),
         arith_r=jnp.where(go[:, None], 0, sf.arith_r),
         arith_pc=jnp.where(go[:, None], 0, sf.arith_pc),
+        arith_cid=jnp.where(go[:, None], 0, sf.arith_cid),
         # retired lanes (reverted / error / non-mutating) free their slots
         # for forks of the surviving ones; their results were consumed by
         # the per-tx detection pass before this call. Loss accounting
@@ -1269,30 +1688,88 @@ def between_txs(sf: SymFrontier) -> SymFrontier:
         # tally in SymExecWrapper counts each lost lane exactly once even
         # after its slot is recycled by expand_forks.
         killed_infeasible=jnp.zeros_like(sf.killed_infeasible),
+        # per-tx loop budget + dependency evidence reset
+        lb_key=jnp.where(go[:, None], -1, sf.lb_key),
+        lb_cnt=jnp.where(go[:, None], 0, sf.lb_cnt),
+        lb_len=jnp.where(go, 0, sf.lb_len),
+        dep_read=jnp.where(go, False, sf.dep_read),
     )
 
 
-def expand_forks(sf: SymFrontier) -> SymFrontier:
+def expand_forks(sf: SymFrontier, loop_bound: int = 0,
+                 fork_block: int = 0,
+                 fork_policy: str = "fifo") -> SymFrontier:
     """Materialize fork requests: copy each forking lane into a free lane
     (prefix-sum compaction), point the copy at the jump target, and flip
     its final path-condition sign to "taken". Forks beyond capacity are
     counted in ``dropped_forks`` (the frontier equivalent of the
-    reference's unbounded ``work_list.append`` ⚠unv)."""
+    reference's unbounded ``work_list.append`` ⚠unv). A copy whose taken
+    target is a BACKWARD jump feeds the bounded-loops policy.
+
+    ``fork_block`` makes the compaction SHARD-LOCAL (VERDICT r2 ask #5):
+    with the lane axis sharded over devices, a global cumsum/sort would
+    gather the whole frontier every superstep. Blocked, every reduction /
+    sort / gather runs along the intra-block axis — lanes fork only into
+    free lanes of their own block, so a block-aligned sharding never
+    communicates here. ``0`` means one global block (single-chip default);
+    results are identical for equal blocking regardless of the mesh.
+
+    ``fork_policy`` is the search-strategy lever (reference: BFS/DFS
+    ``BasicSearchStrategy`` orderings ⚠unv, SURVEY §1 row 7 — here the
+    frontier steps together, so ordering only matters when fork slots run
+    short): "fifo" admits by lane order, "shallow" prefers forks with the
+    SHORTEST path condition (breadth-flavored), "deep" the longest
+    (depth-flavored).
+    """
     P = sf.n_lanes
+    if fork_block > 0 and P % fork_block != 0:
+        # silent fallback would reintroduce the cross-shard gather the
+        # blocking exists to avoid — surface the misconfiguration
+        raise ValueError(f"fork_block {fork_block} must divide P={P}")
+    if fork_block <= 0:
+        fork_block = P
+    B = fork_block
+    G = P // B
+    loc = jnp.arange(B, dtype=I32)[None, :]
+    gidx = jnp.broadcast_to(jnp.arange(G, dtype=I32)[:, None], (G, B))
+    req2 = sf.fork_req.reshape(G, B)
+    free2 = (~sf.base.active).reshape(G, B)
+    n_free = jnp.sum(free2.astype(I32), axis=1, keepdims=True)
+    if fork_policy == "fifo":
+        rank = jnp.cumsum(req2.astype(I32), axis=1) - req2.astype(I32)
+    else:
+        depth = sf.con_len.reshape(G, B)
+        C = sf.con_node.shape[1]
+        key = depth if fork_policy == "shallow" else (C - depth)
+        key = jnp.where(req2, key, C + 1)  # non-requesting lanes sort last
+        order = jnp.argsort(key, axis=1, stable=True).astype(I32)
+        rank = jnp.zeros((G, B), dtype=I32).at[gidx, order].set(
+            jnp.broadcast_to(loc, (G, B)))
+    free_ids = jnp.sort(jnp.where(free2, loc, B), axis=1)
+    slot2 = jnp.where(
+        req2 & (rank < n_free),
+        jnp.take_along_axis(free_ids, jnp.clip(rank, 0, B - 1), axis=1),
+        B,
+    )  # local free-slot index per forking lane; B = dropped
+    src2 = jnp.broadcast_to(loc, (G, B)).at[gidx, slot2].set(
+        jnp.broadcast_to(loc, (G, B)), mode="drop")
+    is_copy = jnp.zeros((G, B), dtype=bool).at[gidx, slot2].set(
+        True, mode="drop").reshape(P)
+    slot = jnp.where(slot2 < B, slot2 + jnp.arange(G, dtype=I32)[:, None] * B,
+                     P).reshape(P)
     req = sf.fork_req
-    free = ~sf.base.active
-    n_free = jnp.sum(free.astype(I32))
-    rank = jnp.cumsum(req.astype(I32)) - req.astype(I32)  # exclusive
-    free_ids = jnp.sort(jnp.where(free, jnp.arange(P, dtype=I32), P))
-    slot = jnp.where(req & (rank < n_free), free_ids[jnp.clip(rank, 0, P - 1)], P)
-    src = jnp.arange(P, dtype=I32).at[slot].set(jnp.arange(P, dtype=I32), mode="drop")
-    is_copy = jnp.zeros(P, dtype=bool).at[slot].set(True, mode="drop")
 
     # scalar run-total counters pass through untouched (ndim == 0); they
-    # must not be gathered over the lane axis
-    new = jax.tree.map(
-        lambda x: x if x.ndim == 0 else jnp.take(x, src, axis=0), sf
-    )
+    # must not be gathered over the lane axis. The gather itself runs
+    # along the intra-block axis only.
+    def _gather(x):
+        if x.ndim == 0:
+            return x
+        xb = x.reshape((G, B) + x.shape[1:])
+        idx = src2.reshape((G, B) + (1,) * (x.ndim - 1))
+        return jnp.take_along_axis(xb, idx, axis=1).reshape(x.shape)
+
+    new = jax.tree.map(_gather, sf)
     b = new.base
     C = new.con_sign.shape[1]
     last = (jnp.arange(C)[None, :] == (new.con_len - 1)[:, None]) & is_copy[:, None]
@@ -1300,7 +1777,10 @@ def expand_forks(sf: SymFrontier) -> SymFrontier:
     # would double-count every prior drop once per fork
     n_dropped = (req & (slot == P)).astype(I32)
     dropped = jnp.where(is_copy, 0, new.dropped_forks) + n_dropped
-    return new.replace(
+    # the source lane sits at (JUMPI pc)+1 after the superstep, so a taken
+    # target strictly below the copied pc is a backward jump
+    back_copy = is_copy & (new.fork_dest < b.pc)
+    new = new.replace(
         base=b.replace(
             pc=jnp.where(is_copy, new.fork_dest, b.pc),
             active=b.active | is_copy,
@@ -1310,39 +1790,57 @@ def expand_forks(sf: SymFrontier) -> SymFrontier:
         dropped_forks=dropped,
         dropped_total=new.dropped_total + jnp.sum(n_dropped, dtype=I32),
     )
+    return _note_backjump(new, back_copy, new.fork_dest, loop_bound)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("spec", "limits", "max_steps", "propagate_every")
+    jax.jit, static_argnames=("spec", "limits", "max_steps", "propagate_every",
+                              "fork_block", "track_coverage", "fork_policy")
 )
 def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
             spec: SymSpec = SymSpec(),
             limits: LimitsConfig = DEFAULT_LIMITS,
             max_steps: int = 256,
-            propagate_every=None) -> SymFrontier:
+            propagate_every=None,
+            fork_block: int = 0,
+            track_coverage: bool = False,
+            fork_policy: str = "fifo"):
     """Run the symbolic engine until quiescence or max_steps supersteps.
     ``propagate_every`` > 0 interleaves feasibility sweeps that kill
     provably-unsat lanes (reference: lazy ``Solver.check()`` pruning);
-    0 disables them; None uses ``limits.propagate_every``."""
+    0 disables them; None uses ``limits.propagate_every``.
+    ``fork_block`` confines fork compaction to lane blocks (pass the
+    per-device lane count when sharding the lane axis).
+    ``track_coverage=True`` additionally returns a ``bool[C, MAX_CODE]``
+    visited-pc bitmap (reference: InstructionCoveragePlugin ⚠unv) —
+    return type becomes ``(sf, visited)``."""
     from .propagate import kill_infeasible
 
     if propagate_every is None:
         propagate_every = limits.propagate_every
 
+    C, MC = corpus.code.shape
+    visited0 = jnp.zeros((C, MC), dtype=bool)
+
     def cond(state):
-        i, s = state
+        i, s, _ = state
         return (i < max_steps) & jnp.any(s.base.running)
 
     def body(state):
-        i, s = state
+        i, s, visited = state
+        if track_coverage:
+            run = s.base.running
+            cid = jnp.where(run, s.base.contract_id, C)
+            pc = jnp.clip(s.base.pc, 0, MC - 1)
+            visited = visited.at[cid, pc].set(True, mode="drop")
         s = sym_superstep(s, env, corpus, spec, limits)
-        s = expand_forks(s)
+        s = expand_forks(s, limits.loop_bound, fork_block, fork_policy)
         if propagate_every:
             s = lax.cond(
                 (i % propagate_every) == propagate_every - 1,
                 kill_infeasible, lambda x: x, s,
             )
-        return i + 1, s
+        return i + 1, s, visited
 
-    _, sf = lax.while_loop(cond, body, (jnp.int32(0), sf))
-    return sf
+    _, sf, visited = lax.while_loop(cond, body, (jnp.int32(0), sf, visited0))
+    return (sf, visited) if track_coverage else sf

@@ -69,6 +69,10 @@ class FreeKind(IntEnum):
     PREVRANDAO = 13
     BLOCKHASH = 14
     RETDATASIZE = 15    # returndata size of an external call; b = call index
+    ECRECOVER = 16      # uninterpreted ecrecover result; b = call index
+    # (the reference also models ecrecover as an uninterpreted function on
+    # symbolic inputs — natives.py ⚠unv; NOT attacker-controlled taint)
+    PRECOMPILE = 17     # other unmodeled precompile output; b = call index
 
 
 # Multi-transaction leaf identity: tx-scoped leaves encode the transaction
@@ -78,6 +82,11 @@ class FreeKind(IntEnum):
 # hash-consing dedups first-tx reads onto the seeds. ORIGIN and the block
 # environment stay global (b = 0) across the sequence.
 TX_STRIDE = 1 << 16
+
+# BALANCE leaves are keyed b = bal_epoch * BAL_STRIDE + account slot: the
+# epoch versions the leaf across concrete balance-table changes (see
+# SymFrontier.bal_epoch). Must exceed LimitsConfig.max_accounts.
+BAL_STRIDE = 256
 
 # Well-known leaves pre-seeded on the tape at fixed ids so the hot paths
 # (CALLDATALOAD, CALLER, CALLVALUE) never need an append. Layout:

@@ -1,19 +1,24 @@
-"""Batched feasibility propagation: unsigned-interval abstract
-interpretation over the per-lane SSA tapes.
+"""Batched feasibility propagation: unsigned-interval + known-bits
+abstract interpretation over the per-lane SSA tapes.
 
 This is the on-device replacement for the cheap majority of the
 reference's ``Solver.check()`` calls (``mythril/laser/smt/solver`` ⚠unv,
 SURVEY.md §2.2): one forward pass assigns every tape node an unsigned
-interval [lo, hi] (u256 as 8xu32 limbs); a path constraint
-``(node, sign)`` is contradicted when the interval proves the node can't
-be nonzero (sign=true) or can't be zero (sign=false). Lanes with any
-contradicted constraint are provably infeasible and get killed.
+interval [lo, hi] (u256 as 8xu32 limbs) AND a known-bits pair
+(mask, value) — bit positions proven constant. A path constraint
+``(node, sign)`` is contradicted when either domain proves the node
+can't be nonzero (sign=true) or can't be zero (sign=false). Lanes with
+any contradicted constraint are provably infeasible and get killed.
 
-Soundness direction: intervals only ever over-approximate, so a kill is
-always correct; undecided lanes stay alive (the reference keeps unsat
+The two domains are complementary: intervals decide magnitude reasoning
+(LT/GT bounds, dispatcher ranges); known-bits decide mask/alignment
+reasoning intervals cannot — e.g. ``(x | 1) == 2`` is unsat because bit 0
+of the LHS is known 1 (VERDICT r2 ask #7).
+
+Soundness direction: both domains only ever over-approximate, so a kill
+is always correct; undecided lanes stay alive (the reference keeps unsat
 paths alive until a solver call too). The expensive exact residue goes to
-the host model search (``concretize.py``) only when a detection module
-needs a witness.
+the host model search only when a detection module needs a witness.
 """
 
 from __future__ import annotations
@@ -53,22 +58,29 @@ def propagate_feasibility(sf: SymFrontier):
     """Forward pass over every lane's tape.
 
     Returns ``(lo, hi, infeasible)``: per-node interval arrays
-    ``u32[P, T, 8]`` and the per-lane infeasibility verdict."""
+    ``u32[P, T, 8]`` and the per-lane infeasibility verdict (intervals
+    AND known-bits combined)."""
     P, T = sf.tape_op.shape
     lo = jnp.zeros((P, T, 8), dtype=U32)
     hi = jnp.zeros((P, T, 8), dtype=U32)  # node 0 == concrete zero: [0, 0]
+    # known-bits: bit set in km -> that bit of the node equals the same
+    # bit of kv. Node 0 is concrete zero: all bits known zero.
+    km = jnp.zeros((P, T, 8), dtype=U32).at[:, 0].set(0xFFFFFFFF)
+    kv = jnp.zeros((P, T, 8), dtype=U32)
 
     def gather(arr, ids):
         return jnp.take_along_axis(arr, jnp.clip(ids, 0, T - 1)[:, None, None].astype(I32).repeat(8, 2), axis=1)[:, 0]
 
     def body(i, carry):
-        lo, hi = carry
+        lo, hi, km, kv = carry
         op = sf.tape_op[:, i]
         a_id = sf.tape_a[:, i]
         b_id = sf.tape_b[:, i]
         imm = sf.tape_imm[:, i]
         la, ha = gather(lo, a_id), gather(hi, a_id)
         lb, hb = gather(lo, b_id), gather(hi, b_id)
+        ka, va = gather(km, a_id), gather(kv, a_id)
+        kb, vb = gather(km, b_id), gather(kv, b_id)
 
         top_lo = jnp.zeros_like(la)
         top_hi = _full_like(ha, True)
@@ -212,21 +224,95 @@ def propagate_feasibility(sf: SymFrontier):
         r_lo = jnp.where(((op == int(SymOp.SLT)) | (op == int(SymOp.SGT)))[:, None], blo, r_lo)
         r_hi = jnp.where(((op == int(SymOp.SLT)) | (op == int(SymOp.SGT)))[:, None], bhi, r_hi)
 
+        # --- known-bits transfer (default: nothing known) ---
+        all1 = _full_like(ha, True)
+        rm = jnp.zeros_like(ha)
+        rv = jnp.zeros_like(ha)
+        rm = jnp.where(is_const[:, None], all1, rm)
+        rv = jnp.where(is_const[:, None], imm, rv)
+        # bounded leaves: the high bits are known zero
+        free_km = jnp.zeros_like(ha)
+        free_km = jnp.where(
+            ((kind == int(FreeKind.CALLER)) | (kind == int(FreeKind.ORIGIN)))[:, None],
+            u256.bit_not(addr_hi), free_km)
+        free_km = jnp.where(
+            ((kind == int(FreeKind.CALLDATASIZE)) | (kind == int(FreeKind.TIMESTAMP))
+             | (kind == int(FreeKind.NUMBER)))[:, None],
+            u256.bit_not(small_hi), free_km)
+        rm = jnp.where(is_free[:, None], free_km, rm)
+
+        # bitwise ops are exact on known bits
+        and_m = (ka & kb) | (ka & ~va) | (kb & ~vb)  # a known-0 forces 0
+        rm = jnp.where((op == int(SymOp.AND))[:, None], and_m, rm)
+        rv = jnp.where((op == int(SymOp.AND))[:, None], va & vb & and_m, rv)
+        or_m = (ka & kb) | (ka & va) | (kb & vb)     # a known-1 forces 1
+        rm = jnp.where((op == int(SymOp.OR))[:, None], or_m, rm)
+        rv = jnp.where((op == int(SymOp.OR))[:, None], (va | vb) & or_m, rv)
+        rm = jnp.where((op == int(SymOp.XOR))[:, None], ka & kb, rm)
+        rv = jnp.where((op == int(SymOp.XOR))[:, None], (va ^ vb) & ka & kb, rv)
+        rm = jnp.where((op == int(SymOp.NOT))[:, None], ka, rm)
+        rv = jnp.where((op == int(SymOp.NOT))[:, None], ~va & ka, rv)
+
+        # shifts by a singleton amount: masks shift too; shifted-in bits
+        # are known zero (tape operand order: a = shift, b = value)
+        shift_conc = sing_a & u256.lt(la, jnp.zeros_like(la).at[:, 0].set(256))
+        ones_shr = u256.shr(la, all1)   # low (256-k) bits set
+        ones_shl = u256.shl(la, all1)   # high (256-k) bits set
+        shr_m = u256.shr(la, kb) | u256.bit_not(ones_shr)
+        shl_m = u256.shl(la, kb) | u256.bit_not(ones_shl)
+        is_shr_c = (op == int(SymOp.SHR)) & shift_conc
+        is_shl_c = (op == int(SymOp.SHL)) & shift_conc
+        rm = jnp.where(is_shr_c[:, None], shr_m, rm)
+        rv = jnp.where(is_shr_c[:, None], u256.shr(la, vb), rv)
+        rm = jnp.where(is_shl_c[:, None], shl_m, rm)
+        rv = jnp.where(is_shl_c[:, None], u256.shl(la, vb), rv)
+
+        # boolean producers: bits 1..255 known zero; the verdict bit when
+        # known-bits alone decide it
+        is_bool = ((op == int(SymOp.LT)) | (op == int(SymOp.GT))
+                   | (op == int(SymOp.SLT)) | (op == int(SymOp.SGT))
+                   | (op == int(SymOp.EQ)) | (op == int(SymOp.ISZERO)))
+        not_one = u256.bit_not(t_one)
+        diff = (va ^ vb) & ka & kb
+        kb_ne = ~u256.is_zero(diff)                       # EQ surely false
+        a_full = jnp.all(ka == 0xFFFFFFFF, axis=-1)
+        b_full = jnp.all(kb == 0xFFFFFFFF, axis=-1)
+        kb_eq = a_full & b_full & u256.is_zero(va ^ vb)   # EQ surely true
+        isz_nz = ~u256.is_zero(va & ka)                   # ISZERO surely 0
+        isz_z = a_full & u256.is_zero(va)                 # ISZERO surely 1
+        rm = jnp.where(is_bool[:, None], not_one, rm)
+        rv = jnp.where(is_bool[:, None], 0, rv)
+        eq_dec = (op == int(SymOp.EQ)) & (kb_ne | kb_eq)
+        isz_dec = (op == int(SymOp.ISZERO)) & (isz_nz | isz_z)
+        dec = eq_dec | isz_dec
+        dec_one = ((op == int(SymOp.EQ)) & kb_eq) | ((op == int(SymOp.ISZERO)) & isz_z)
+        rm = jnp.where(dec[:, None], all1, rm)
+        rv = jnp.where(dec_one[:, None], t_one, rv)
+
         live = (jnp.int32(i) < sf.tape_len) & (op != int(SymOp.NULL))
         lo = lo.at[:, i].set(jnp.where(live[:, None], r_lo, lo[:, i]))
         hi = hi.at[:, i].set(jnp.where(live[:, None], r_hi, hi[:, i]))
-        return lo, hi
+        km = km.at[:, i].set(jnp.where(live[:, None], rm, km[:, i]))
+        kv = kv.at[:, i].set(jnp.where(live[:, None], rv, kv[:, i]))
+        return lo, hi, km, kv
 
-    lo, hi = lax.fori_loop(1, T, body, (lo, hi))
+    lo, hi, km, kv = lax.fori_loop(1, T, body, (lo, hi, km, kv))
 
-    # constraint check
+    # constraint check (either domain may contradict)
     C = sf.con_node.shape[1]
     con_live = jnp.arange(C)[None, :] < sf.con_len[:, None]
     node = jnp.clip(sf.con_node, 0, T - 1)
-    n_lo = jnp.take_along_axis(lo, node[:, :, None].repeat(8, 2), axis=1)
-    n_hi = jnp.take_along_axis(hi, node[:, :, None].repeat(8, 2), axis=1)
-    cant_be_nonzero = jnp.all(n_hi == 0, axis=-1)
-    cant_be_zero = ~jnp.all(n_lo == 0, axis=-1)
+    idx = node[:, :, None].repeat(8, 2)
+    n_lo = jnp.take_along_axis(lo, idx, axis=1)
+    n_hi = jnp.take_along_axis(hi, idx, axis=1)
+    n_km = jnp.take_along_axis(km, idx, axis=1)
+    n_kv = jnp.take_along_axis(kv, idx, axis=1)
+    cant_be_nonzero = jnp.all(n_hi == 0, axis=-1) | (
+        jnp.all(n_km == 0xFFFFFFFF, axis=-1) & jnp.all(n_kv == 0, axis=-1)
+    )
+    cant_be_zero = ~jnp.all(n_lo == 0, axis=-1) | jnp.any(
+        (n_kv & n_km) != 0, axis=-1
+    )
     contradicted = con_live & (sf.con_node != 0) & jnp.where(
         sf.con_sign, cant_be_nonzero, cant_be_zero
     )

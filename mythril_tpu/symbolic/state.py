@@ -65,16 +65,24 @@ class SymFrontier:
     cd_havoc: jnp.ndarray    # bool[P] this frame's calldata bytes unknown
     cd_sym: jnp.ndarray      # i32[P, CD/32] per-word sym ids of frame calldata
     callvalue_sym: jnp.ndarray  # i32[P] sym id of this frame's callvalue
+    caller_sym: jnp.ndarray  # i32[P] sym id of this frame's msg.sender (0 =
+    # concrete; a DELEGATECALL frame inherits the caller frame's CALLER leaf)
+    bal_epoch: jnp.ndarray   # i32[P] balance-leaf version: bumped whenever the
+    # concrete balance table changes (transfer / rollback / tx boundary) so
+    # BALANCE reads across the change get fresh leaves instead of being
+    # forced equal (advisor r2 low)
     fr_mem_sym: jnp.ndarray  # i32[P, D, M/32] saved caller memory overlay
     fr_mem_havoc: jnp.ndarray  # bool[P, D]
     fr_cd_from_mem: jnp.ndarray  # bool[P, D]
     fr_cd_havoc: jnp.ndarray  # bool[P, D]
     fr_cd_sym: jnp.ndarray   # i32[P, D, CD/32]
     fr_callvalue_sym: jnp.ndarray  # i32[P, D]
+    fr_caller_sym: jnp.ndarray  # i32[P, D]
     fr_st_val_sym: jnp.ndarray  # i32[P, D, K] storage-overlay snapshots
     fr_st_key_sym: jnp.ndarray  # i32[P, D, K]  (revert rollback)
     sub_revert_pc: jnp.ndarray  # i32[P] pc of the CALL whose callee
     # reverted/failed (-1 = none; SWC-123 RequirementsViolation feed)
+    sub_revert_cid: jnp.ndarray  # i32[P] contract owning that CALL site
     # --- SSA tape ---
     tape_op: jnp.ndarray     # i32[P, T]
     tape_a: jnp.ndarray      # i32[P, T]
@@ -82,6 +90,7 @@ class SymFrontier:
     tape_imm: jnp.ndarray    # u32[P, T, 8]
     tape_len: jnp.ndarray    # i32[P]
     havoc_cnt: jnp.ndarray   # i32[P] fresh-variable counter (HAVOC uniqueness)
+    create_cnt: jnp.ndarray  # i32[P] CREATE/CREATE2 counter (fresh addresses)
     # --- path condition ---
     tx_id: jnp.ndarray       # i32[P] current transaction index (0-based)
     con_node: jnp.ndarray    # i32[P, C]
@@ -91,14 +100,24 @@ class SymFrontier:
     killed_infeasible: jnp.ndarray  # bool[P] pruned by constraint propagation
     killed_total: jnp.ndarray  # i32[] run total of propagation kills (survives
     # lane recycling — per-lane flags are lost when expand_forks reuses a slot)
+    # --- bounded-loops policy (reference: BoundedLoopsStrategy ⚠unv) ---
+    lb_key: jnp.ndarray      # i32[P, LBS] back-jump target keys (cid*32768+pc)
+    lb_cnt: jnp.ndarray      # i32[P, LBS] taken-count per target
+    lb_len: jnp.ndarray      # i32[P]
+    # --- dependency pruner (reference: DependencyPruner ⚠unv) ---
+    dep_read: jnp.ndarray    # bool[P] this tx read a key a PRIOR tx wrote
     # --- fork plumbing (filled by the JUMPI handler, drained by expand_forks) ---
     fork_req: jnp.ndarray    # bool[P]
     fork_dest: jnp.ndarray   # i32[P] jump target of the taken branch
     dropped_forks: jnp.ndarray  # i32[P] forks lost to capacity (reported)
     dropped_total: jnp.ndarray  # i32[] run total of dropped forks
     # --- detection-facing event records ---
+    # every pc-bearing event also records the EXECUTING contract id at
+    # record time (``*_cid``): a pc recorded inside a callee frame must not
+    # be attributed to the lane's home contract (advisor r2 medium)
     sym_jump_dest: jnp.ndarray  # i32[P] node id of a symbolic JUMP dest (SWC-127)
     sym_jump_pc: jnp.ndarray    # i32[P] pc of that jump (-1 = none)
+    sym_jump_cid: jnp.ndarray   # i32[P] contract executing that jump
     n_calls: jnp.ndarray     # i32[P]
     n_mut_calls: jnp.ndarray  # i32[P] CALL/CALLCODE/DELEGATECALL only (re-enterable)
     call_to: jnp.ndarray     # u32[P, CL, 8] concrete callee (if concrete)
@@ -107,15 +126,24 @@ class SymFrontier:
     call_value_sym: jnp.ndarray  # i32[P, CL]
     call_op: jnp.ndarray     # i32[P, CL] raw opcode (CALL/DELEGATECALL/...)
     call_pc: jnp.ndarray     # i32[P, CL]
+    call_cid: jnp.ndarray    # i32[P, CL] contract executing the call site
+    # LOG record overlay: sym id of topic0 / first data word per record
+    # (0 = concrete, -1 = unknown at symbolic offset / havoc'd memory)
+    log_topic0_sym: jnp.ndarray  # i32[P, LS]
+    log_data0_sym: jnp.ndarray   # i32[P, LS]
     sd_to_sym: jnp.ndarray   # i32[P] SELFDESTRUCT beneficiary sym id
     sd_to: jnp.ndarray       # u32[P, 8] concrete beneficiary
     sd_pc: jnp.ndarray       # i32[P] pc of the first SELFDESTRUCT (-1 = none)
+    sd_cid: jnp.ndarray      # i32[P] contract whose code executed it
     # one-shot event records for the remaining SWC modules
     origin_read: jnp.ndarray  # bool[P] lane executed ORIGIN (SWC-111/115)
     inv_pc: jnp.ndarray      # i32[P] pc of an executed INVALID (-1 = none; SWC-110)
+    inv_cid: jnp.ndarray     # i32[P]
     sstore_after_call_pc: jnp.ndarray  # i32[P] first SSTORE after an ext call (SWC-107)
+    sstore_ac_cid: jnp.ndarray  # i32[P]
     arb_key_node: jnp.ndarray  # i32[P] key node of first symbolic-key SSTORE (SWC-124)
     arb_key_pc: jnp.ndarray    # i32[P]
+    arb_key_cid: jnp.ndarray   # i32[P]
     # symbolic-arithmetic events (IntegerArithmetics SWC-101 feed)
     n_arith: jnp.ndarray     # i32[P]
     arith_op: jnp.ndarray    # i32[P, AL] EVM opcode (ADD/SUB/MUL/EXP)
@@ -123,6 +151,7 @@ class SymFrontier:
     arith_b: jnp.ndarray     # i32[P, AL]
     arith_r: jnp.ndarray     # i32[P, AL] result node id
     arith_pc: jnp.ndarray    # i32[P, AL]
+    arith_cid: jnp.ndarray   # i32[P, AL]
 
     @property
     def n_lanes(self) -> int:
@@ -187,21 +216,26 @@ def make_sym_frontier(
         cd_havoc=jnp.zeros(P, dtype=bool),
         cd_sym=z(P, CDW),
         callvalue_sym=z(P),
+        caller_sym=z(P),
+        bal_epoch=z(P),
         fr_mem_sym=z(P, D, L.mem_bytes // 32),
         fr_mem_havoc=jnp.zeros((P, D), dtype=bool),
         fr_cd_from_mem=jnp.zeros((P, D), dtype=bool),
         fr_cd_havoc=jnp.zeros((P, D), dtype=bool),
         fr_cd_sym=z(P, D, CDW),
         fr_callvalue_sym=z(P, D),
+        fr_caller_sym=z(P, D),
         fr_st_val_sym=z(P, D, K),
         fr_st_key_sym=z(P, D, K),
         sub_revert_pc=jnp.full(P, -1, dtype=I32),
+        sub_revert_cid=z(P),
         tape_op=jnp.asarray(t_op),
         tape_a=jnp.asarray(t_a),
         tape_b=jnp.asarray(t_b),
         tape_imm=jnp.zeros((P, T, 8), dtype=U32),
         tape_len=jnp.full(P, n_wk, dtype=I32),
         havoc_cnt=z(P),
+        create_cnt=z(P),
         tx_id=z(P),
         con_node=z(P, C),
         con_sign=jnp.zeros((P, C), dtype=bool),
@@ -209,12 +243,17 @@ def make_sym_frontier(
         con_len=z(P),
         killed_infeasible=jnp.zeros(P, dtype=bool),
         killed_total=jnp.zeros((), dtype=I32),
+        lb_key=jnp.full((P, L.loop_slots), -1, dtype=I32),
+        lb_cnt=z(P, L.loop_slots),
+        lb_len=z(P),
+        dep_read=jnp.zeros(P, dtype=bool),
         fork_req=jnp.zeros(P, dtype=bool),
         fork_dest=z(P),
         dropped_forks=z(P),
         dropped_total=jnp.zeros((), dtype=I32),
         sym_jump_dest=z(P),
         sym_jump_pc=jnp.full(P, -1, dtype=I32),
+        sym_jump_cid=z(P),
         n_calls=z(P),
         n_mut_calls=z(P),
         call_to=jnp.zeros((P, CL, 8), dtype=U32),
@@ -223,18 +262,26 @@ def make_sym_frontier(
         call_value_sym=z(P, CL),
         call_op=z(P, CL),
         call_pc=z(P, CL),
+        call_cid=z(P, CL),
+        log_topic0_sym=z(P, L.log_slots),
+        log_data0_sym=z(P, L.log_slots),
         sd_to_sym=z(P),
         sd_to=jnp.zeros((P, 8), dtype=U32),
         sd_pc=jnp.full(P, -1, dtype=I32),
+        sd_cid=z(P),
         origin_read=jnp.zeros(P, dtype=bool),
         inv_pc=jnp.full(P, -1, dtype=I32),
+        inv_cid=z(P),
         sstore_after_call_pc=jnp.full(P, -1, dtype=I32),
+        sstore_ac_cid=z(P),
         arb_key_node=z(P),
         arb_key_pc=jnp.full(P, -1, dtype=I32),
+        arb_key_cid=z(P),
         n_arith=z(P),
         arith_op=z(P, L.arith_log),
         arith_a=z(P, L.arith_log),
         arith_b=z(P, L.arith_log),
         arith_r=z(P, L.arith_log),
         arith_pc=z(P, L.arith_log),
+        arith_cid=z(P, L.arith_log),
     )

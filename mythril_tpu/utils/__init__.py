@@ -1,0 +1,1 @@
+"""Cross-cutting support (reference: ``mythril/support/`` ⚠unv)."""

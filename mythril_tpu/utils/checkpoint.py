@@ -1,0 +1,71 @@
+"""Frontier checkpoint / resume.
+
+The reference has NO checkpointing (SURVEY.md §5.4 marks it absent and
+required for pod-scale runs). The SoA design makes it nearly free: a
+:class:`SymFrontier` is a pytree of fixed-shape arrays, so a checkpoint
+is one ``npz`` of named leaves plus a JSON meta blob (tx index, segment
+counter). Resume = load the arrays back into a template frontier of the
+same shape config.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Dict, Tuple
+
+import numpy as np
+import jax
+
+
+def _leaf_names(tree) -> Tuple[list, Any]:
+    """Stable dotted names for every leaf + the treedef."""
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names, leaves = [], []
+    for path, leaf in leaves_with_path:
+        names.append("/".join(str(getattr(p, "name", getattr(p, "idx", p)))
+                              for p in path))
+        leaves.append(leaf)
+    return list(zip(names, leaves)), treedef
+
+
+def save_frontier(path: str, sf, meta: Dict | None = None) -> None:
+    """Serialize a SymFrontier (or any pytree of arrays) + meta to npz."""
+    named, _ = _leaf_names(sf)
+    arrays = {f"leaf{i}::{name}": np.asarray(leaf)
+              for i, (name, leaf) in enumerate(named)}
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8)
+    with open(path, "wb") as fh:
+        np.savez_compressed(fh, **arrays)
+
+
+def load_frontier(path: str, template) -> Tuple[Any, Dict]:
+    """Rebuild a pytree from `path` using `template` for the structure.
+
+    The template must have the same shape configuration (lanes + limits)
+    the checkpoint was written with; leaf names are cross-checked.
+    """
+    with open(path, "rb") as fh:
+        data = np.load(io.BytesIO(fh.read()))
+    meta = json.loads(bytes(data["__meta__"]).decode()) if "__meta__" in data else {}
+    named, treedef = _leaf_names(template)
+    by_index = {}
+    for key in data.files:
+        if key == "__meta__":
+            continue
+        idx_s, name = key.split("::", 1)
+        by_index[int(idx_s[4:])] = (name, data[key])
+    leaves = []
+    for i, (name, tmpl_leaf) in enumerate(named):
+        if i not in by_index:
+            raise ValueError(f"checkpoint missing leaf {i} ({name})")
+        got_name, arr = by_index[i]
+        if got_name != name:
+            raise ValueError(
+                f"checkpoint layout mismatch at leaf {i}: {got_name!r} != {name!r}")
+        if tuple(arr.shape) != tuple(np.shape(tmpl_leaf)):
+            raise ValueError(
+                f"shape mismatch for {name}: {arr.shape} vs {np.shape(tmpl_leaf)}")
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves), meta

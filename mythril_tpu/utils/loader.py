@@ -1,0 +1,50 @@
+"""Dynamic on-chain loading interface (reference: ``mythril/support/
+loader.py`` + ``mythril/ethereum/interface/rpc`` ⚠unv).
+
+This environment has ZERO network egress, so there is no live JSON-RPC
+client — the surface is interface-shaped and pluggable: anything with
+``eth_getCode`` / ``eth_getStorageAt`` works (the reference's tests mock
+RPC the same way, SURVEY.md §4 "RPC tests"). Loaded code/storage feed the
+analysis as ordinary bytecode / concrete storage seeds; there is no
+mid-execution dynamic loading (the corpus is device-resident and static
+per run — a deliberate frontier-first divergence).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+
+class RpcClient(Protocol):
+    def eth_getCode(self, address: str) -> str: ...
+    def eth_getStorageAt(self, address: str, slot: str) -> str: ...
+
+
+class DynLoaderError(RuntimeError):
+    pass
+
+
+class DynLoader:
+    """Front door for on-chain lookups (reference: ``DynLoader.dynld`` /
+    ``read_storage`` ⚠unv)."""
+
+    def __init__(self, client: Optional[RpcClient] = None):
+        self.client = client
+
+    def _require(self) -> RpcClient:
+        if self.client is None:
+            raise DynLoaderError(
+                "no RPC client configured (this environment has no network "
+                "egress; plug in any object with eth_getCode/eth_getStorageAt)"
+            )
+        return self.client
+
+    def dynld(self, address: int) -> bytes:
+        """Runtime bytecode of a live contract."""
+        code = self._require().eth_getCode(f"0x{address:040x}")
+        return bytes.fromhex(code.removeprefix("0x"))
+
+    def read_storage(self, address: int, slot: int) -> int:
+        word = self._require().eth_getStorageAt(
+            f"0x{address:040x}", f"0x{slot:x}")
+        return int(word, 16)

@@ -2,8 +2,10 @@
 
 Mirrors the reference's "no chain needed" test philosophy (SURVEY.md §4):
 the reference tests LASER with hand-built fixtures and mocked RPC; we test
-the TPU framework on a virtual 8-device CPU mesh so CI needs no TPU, and
-multi-chip sharding is exercised via xla_force_host_platform_device_count.
+the TPU framework on a virtual 8-device CPU mesh so CI needs no TPU.
+``tests/test_sharding.py`` shards the symbolic engine's lane axis over
+this mesh and asserts bit-equivalence with the unsharded run; the other
+suites run single-device.
 """
 
 import os
@@ -29,7 +31,16 @@ except RuntimeError:
     pass  # backend already initialized by an earlier plugin import
 
 # Persistent compilation cache: the superstep graph is large and this box has
-# one core — cache compiled executables across test runs.
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# one core — cache compiled executables across test runs. A crashed writer
+# can leave a corrupt entry that segfaults later readers; wipe .jax_cache
+# or set MYTHRIL_NO_JAX_CACHE=1 if the suite dies inside jax compile/cache
+# frames.
+if os.environ.get("MYTHRIL_NO_JAX_CACHE") != "1":
+    # per-xdist-worker cache dir: concurrent workers must not race writes
+    # into one cache (worker ids are stable, so reuse across runs holds)
+    _worker = os.environ.get("PYTEST_XDIST_WORKER", "gw0")
+    _CACHE_DIR = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        f".jax_cache_{_worker}")
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,116 @@
+"""Execution deadline + frontier checkpoint/resume (VERDICT r2 ask #9).
+
+Reference: ``--execution-timeout`` degrade semantics (SURVEY §5.3);
+checkpointing is ABSENT in the reference — SURVEY §5.4 requires it here
+for pod runs.
+"""
+
+import dataclasses
+
+import numpy as np
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.config import TEST_LIMITS
+from mythril_tpu.core import Corpus, make_env
+from mythril_tpu.disassembler import ContractImage
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.symbolic import SymSpec, make_sym_frontier, sym_run
+from mythril_tpu.utils.checkpoint import load_frontier, save_frontier
+from mythril_tpu.analysis import SymExecWrapper, fire_lasers
+
+L = TEST_LIMITS
+# loop bounding off so a spinner really spins (deadline must catch it)
+L_NOLB = dataclasses.replace(TEST_LIMITS, loop_bound=0)
+
+SPINNER = assemble(("label", "top"), ("ref", "top"), "JUMP")
+BRANCHY = assemble(
+    0, "CALLDATALOAD", ("ref", "a"), "JUMPI",
+    1, 0, "SSTORE",
+    4, "CALLDATALOAD", ("ref", "b"), "JUMPI",
+    2, 1, "SSTORE", "STOP",
+    ("label", "a"), 3, 0, "SSTORE", "STOP",
+    ("label", "b"), 4, 1, "SSTORE", "STOP",
+)
+
+
+def test_deadline_aborts_spinner_with_partial_coverage():
+    sym = SymExecWrapper(
+        [SPINNER], limits=L_NOLB, lanes_per_contract=4,
+        max_steps=1_000_000, transaction_count=2,
+        execution_timeout=0.0, deadline_chunk_steps=8,
+    )
+    assert sym.timed_out
+    assert len(sym.tx_contexts) == 1, "deadline stops further transactions"
+    cov = sym.coverage
+    assert cov.get("deadline_expired_running", 0) >= 1
+    report = fire_lasers(sym)
+    assert any("execution timeout" in w for w in report.coverage_warnings())
+
+
+def test_deadline_not_hit_reports_clean():
+    sym = SymExecWrapper(
+        [assemble("STOP")], limits=L, lanes_per_contract=4,
+        max_steps=64, transaction_count=1, execution_timeout=300.0,
+    )
+    assert not sym.timed_out
+    assert "deadline_expired_running" not in sym.coverage
+
+
+def _build(P=8):
+    img = ContractImage.from_bytecode(BRANCHY, L.max_code)
+    corpus = Corpus.from_images([img])
+    active = np.zeros(P, dtype=bool)
+    active[0] = True
+    sf = make_sym_frontier(P, L, active=active)
+    env = make_env(P)
+    return sf, env, corpus
+
+
+def _equal_trees(a, b) -> bool:
+    import jax
+
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(leaves_a, leaves_b))
+
+
+def test_checkpoint_roundtrip_and_resume(tmp_path):
+    sf, env, corpus = _build()
+    spec = SymSpec()
+
+    # uninterrupted reference run (64+64 segments use the same compiled
+    # executable as the reference's shape family)
+    ref = sym_run(sf, env, corpus, spec, L, max_steps=128)
+
+    # segmented: 64 steps -> checkpoint -> reload -> continue 64
+    mid = sym_run(sf, env, corpus, spec, L, max_steps=64)
+    path = str(tmp_path / "ck.npz")
+    save_frontier(path, mid, {"tx": 0, "steps_done": 64})
+    template = _build()[0]
+    loaded, meta = load_frontier(path, template)
+    assert meta == {"tx": 0, "steps_done": 64}
+    assert _equal_trees(mid, loaded), "round-trip must be lossless"
+    out = sym_run(loaded, env, corpus, spec, L, max_steps=64)
+    assert _equal_trees(ref, out), "resumed run must match uninterrupted"
+
+
+def test_checkpoint_shape_mismatch_rejected(tmp_path):
+    sf, _, _ = _build(P=8)
+    path = str(tmp_path / "ck.npz")
+    save_frontier(path, sf)
+    import pytest
+
+    with pytest.raises(ValueError):
+        load_frontier(path, _build(P=16)[0])
+
+
+def test_wrapper_writes_checkpoints(tmp_path):
+    import os
+
+    SymExecWrapper(
+        [BRANCHY], limits=L, lanes_per_contract=4, max_steps=64,
+        transaction_count=1, checkpoint_dir=str(tmp_path / "ckpts"),
+        deadline_chunk_steps=64,
+    )
+    assert os.path.exists(str(tmp_path / "ckpts" / "frontier.npz"))

@@ -55,7 +55,7 @@ def test_stack_cap_trip_is_warned_in_report():
 def test_clean_run_has_no_warnings():
     clean = assemble(1, ("push1", 0), "SSTORE", "STOP")
     sym = SymExecWrapper([clean], limits=TEST_LIMITS,
-                         lanes_per_contract=4, max_steps=32)
+                         lanes_per_contract=4, max_steps=64)
     report = fire_lasers(sym)
     assert report.coverage["lanes_lost_to_caps"] == 0
     assert report.coverage_warnings() == []

