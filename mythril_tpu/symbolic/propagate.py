@@ -55,28 +55,39 @@ def _bound_2exp(shape, bits: int):
 
 
 def propagate_feasibility(sf: SymFrontier):
-    """Forward pass over every lane's tape.
+    """INCREMENTAL forward pass over every lane's tape.
 
-    Returns ``(lo, hi, infeasible)``: per-node interval arrays
-    ``u32[P, T, 8]`` and the per-lane infeasibility verdict (intervals
-    AND known-bits combined)."""
+    The tape is SSA append-only, so a node's domains never change once
+    computed: the pass resumes from ``prop_len`` (the persistent
+    ``iv_lo``/``iv_hi``/``kb_m``/``kb_v`` arrays hold earlier nodes) and
+    walks only to the current ``tape_len`` — typically a handful of new
+    nodes per sweep instead of the full static tape capacity, which
+    measured as ~96% of symbolic runtime before this change.
+
+    Returns ``(sf, infeasible)``: the frontier with updated domain arrays
+    + ``prop_len``, and the per-lane infeasibility verdict (intervals AND
+    known-bits combined)."""
     P, T = sf.tape_op.shape
-    lo = jnp.zeros((P, T, 8), dtype=U32)
-    hi = jnp.zeros((P, T, 8), dtype=U32)  # node 0 == concrete zero: [0, 0]
+    lo, hi = sf.iv_lo, sf.iv_hi   # node 0 == concrete zero: [0, 0]
     # known-bits: bit set in km -> that bit of the node equals the same
     # bit of kv. Node 0 is concrete zero: all bits known zero.
-    km = jnp.zeros((P, T, 8), dtype=U32).at[:, 0].set(0xFFFFFFFF)
-    kv = jnp.zeros((P, T, 8), dtype=U32)
+    km, kv = sf.kb_m, sf.kb_v
 
     def gather(arr, ids):
         return jnp.take_along_axis(arr, jnp.clip(ids, 0, T - 1)[:, None, None].astype(I32).repeat(8, 2), axis=1)[:, 0]
 
-    def body(i, carry):
+    def body(idx, carry):
+        # idx is PER-LANE (i32[P]): lane p processes its own node
+        # prop_len[p] + j this iteration — the loop trip count is the max
+        # NEW-node count, not the global tape span (SSA order guarantees
+        # operands were processed in an earlier sweep or iteration)
         lo, hi, km, kv = carry
-        op = sf.tape_op[:, i]
-        a_id = sf.tape_a[:, i]
-        b_id = sf.tape_b[:, i]
-        imm = sf.tape_imm[:, i]
+        ci = jnp.clip(idx, 0, T - 1)[:, None]
+        op = jnp.take_along_axis(sf.tape_op, ci, axis=1)[:, 0]
+        a_id = jnp.take_along_axis(sf.tape_a, ci, axis=1)[:, 0]
+        b_id = jnp.take_along_axis(sf.tape_b, ci, axis=1)[:, 0]
+        imm = jnp.take_along_axis(sf.tape_imm, ci[:, :, None].repeat(8, 2),
+                                  axis=1)[:, 0]
         la, ha = gather(lo, a_id), gather(hi, a_id)
         lb, hb = gather(lo, b_id), gather(hi, b_id)
         ka, va = gather(km, a_id), gather(kv, a_id)
@@ -289,14 +300,30 @@ def propagate_feasibility(sf: SymFrontier):
         rm = jnp.where(dec[:, None], all1, rm)
         rv = jnp.where(dec_one[:, None], t_one, rv)
 
-        live = (jnp.int32(i) < sf.tape_len) & (op != int(SymOp.NULL))
-        lo = lo.at[:, i].set(jnp.where(live[:, None], r_lo, lo[:, i]))
-        hi = hi.at[:, i].set(jnp.where(live[:, None], r_hi, hi[:, i]))
-        km = km.at[:, i].set(jnp.where(live[:, None], rm, km[:, i]))
-        kv = kv.at[:, i].set(jnp.where(live[:, None], rv, kv[:, i]))
+        live = (idx >= 1) & (idx < sf.tape_len) & (op != int(SymOp.NULL))
+        lanes = jnp.arange(idx.shape[0])
+        widx = jnp.where(live, jnp.clip(idx, 0, T - 1), T)
+        lo = lo.at[lanes, widx].set(r_lo, mode="drop")
+        hi = hi.at[lanes, widx].set(r_hi, mode="drop")
+        km = km.at[lanes, widx].set(rm, mode="drop")
+        kv = kv.at[lanes, widx].set(rv, mode="drop")
         return lo, hi, km, kv
 
-    lo, hi, km, kv = lax.fori_loop(1, T, body, (lo, hi, km, kv))
+    # per-lane resume: lane p walks nodes [prop_len[p], tape_len[p]);
+    # trip count = the largest new-node count over lanes
+    base_idx = jnp.maximum(sf.prop_len, 1).astype(jnp.int32)
+    stop = jnp.max(sf.tape_len - base_idx).astype(jnp.int32)
+
+    def wbody(state):
+        j, carry = state
+        return j + 1, body(base_idx + j, carry)
+
+    _, (lo, hi, km, kv) = lax.while_loop(
+        lambda s: s[0] < stop, wbody, (jnp.int32(0), (lo, hi, km, kv)))
+    sf = sf.replace(
+        iv_lo=lo, iv_hi=hi, kb_m=km, kb_v=kv,
+        prop_len=jnp.maximum(sf.prop_len, sf.tape_len),
+    )
 
     # constraint check (either domain may contradict)
     C = sf.con_node.shape[1]
@@ -317,12 +344,12 @@ def propagate_feasibility(sf: SymFrontier):
         sf.con_sign, cant_be_nonzero, cant_be_zero
     )
     infeasible = jnp.any(contradicted, axis=1)
-    return lo, hi, infeasible
+    return sf, infeasible
 
 
 def kill_infeasible(sf: SymFrontier) -> SymFrontier:
     """Deactivate lanes whose path condition is provably unsatisfiable."""
-    _, _, inf = propagate_feasibility(sf)
+    sf, inf = propagate_feasibility(sf)
     # errored lanes stay resident (not recycled) until the tx boundary so
     # their err_code survives for the per-tx trap tally; they are also not
     # "kills" — the trap already accounts for them
