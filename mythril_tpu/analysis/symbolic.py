@@ -20,7 +20,7 @@ from ..core.frontier import ATTACKER_ADDRESS, CAP_TRAPS, TRAP_NAMES
 from ..disassembler import ContractImage
 from ..smt.eval import Assignment
 from ..smt.solver import solve_tape
-from ..smt.tape import HostTape, extract_tape
+from ..smt.tape import HostTape, TapeHostCache, extract_tape
 from ..symbolic import SymSpec, between_txs, make_sym_frontier, sym_run
 
 
@@ -41,6 +41,7 @@ class AnalysisContext:
     # quiescence (reference: --execution-timeout degrade, SURVEY §5.3)
     timed_out: bool = False
     _tapes: Dict[int, HostTape] = field(default_factory=dict)
+    _tape_cache: Optional[TapeHostCache] = field(default=None, repr=False)
 
     def lanes(self, include_errors: bool = False,
               include_reverted: bool = False) -> np.ndarray:
@@ -62,7 +63,10 @@ class AnalysisContext:
 
     def tape(self, lane: int) -> HostTape:
         if lane not in self._tapes:
-            self._tapes[lane] = extract_tape(self.sf, lane)
+            if self._tape_cache is None:
+                self._tape_cache = TapeHostCache(self.sf)
+            self._tapes[lane] = extract_tape(self.sf, lane,
+                                             cache=self._tape_cache)
         return self._tapes[lane]
 
     def solve(self, lane: int, extra_constraints=(),

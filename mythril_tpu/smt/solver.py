@@ -319,9 +319,12 @@ class Solver:
 
 
 def solve_lane(sf, lane: int, extra_constraints=(), seed: int = 0,
-               max_iters: int = 400) -> Optional[Assignment]:
-    """Witness for lane `lane`'s path condition + extra (node, sign) pairs."""
+               max_iters: int = 400, cache=None) -> Optional[Assignment]:
+    """Witness for lane `lane`'s path condition + extra (node, sign)
+    pairs. Pass a ``TapeHostCache`` when solving many lanes of one
+    frontier — the cacheless default bulk-copies the tape arrays per
+    call."""
     from .tape import extract_tape
 
-    tape = extract_tape(sf, lane, extra_constraints)
+    tape = extract_tape(sf, lane, extra_constraints, cache=cache)
     return solve_tape(tape, seed=seed, max_iters=max_iters)
