@@ -182,3 +182,37 @@ class Report:
             },
             sort_keys=True,
         )
+
+    def as_jsonv2(self) -> str:
+        """MythX-style report shape (reference: ``get_output_jsonv2`` in
+        ``mythril/analysis/report.py`` ⚠unv): one entry per analyzed
+        source, issues with head/tail descriptions and srcmap-style
+        locations."""
+        sources = sorted({i.filename or i.contract or "bytecode"
+                          for i in self.issues}) or ["bytecode"]
+        src_idx = {s: k for k, s in enumerate(sources)}
+        issues = []
+        for i in self.sorted():
+            issues.append({
+                "swcID": f"SWC-{i.swc_id}",
+                "swcTitle": SWC_TITLES.get(i.swc_id, ""),
+                "description": {"head": i.title,
+                                "tail": i.description.strip()},
+                "severity": i.severity,
+                "locations": [{
+                    "sourceMap": f"{i.address}:1:"
+                                 f"{src_idx.get(i.filename or i.contract or 'bytecode', 0)}",
+                }],
+                "extra": {
+                    "contract": i.contract,
+                    "function": i.function,
+                    "testCases": i.transaction_sequence,
+                },
+            })
+        return json.dumps([{
+            "issues": issues,
+            "sourceType": "raw-bytecode",
+            "sourceFormat": "evm-byzantium-bytecode",
+            "sourceList": sources,
+            "meta": {"coverage": self.coverage},
+        }], sort_keys=True)

@@ -46,7 +46,8 @@ def create_parser() -> argparse.ArgumentParser:
                    help="number of attacker message-call transactions")
     a.add_argument("-m", "--modules", metavar="LIST",
                    help="comma-separated detection-module allow list")
-    a.add_argument("-o", "--outform", choices=["text", "markdown", "json"],
+    a.add_argument("-o", "--outform",
+                   choices=["text", "markdown", "json", "jsonv2"],
                    default="text")
     a.add_argument("--max-steps", type=int, default=512,
                    help="superstep budget per transaction")
@@ -136,6 +137,8 @@ def exec_analyze(args) -> int:
         _write_graph(args.graph, contracts[0], analyzer)
     if args.outform == "json":
         print(report.as_json())
+    elif args.outform == "jsonv2":
+        print(report.as_jsonv2())
     elif args.outform == "markdown":
         print(report.as_markdown())
     else:

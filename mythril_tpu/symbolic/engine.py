@@ -1428,6 +1428,29 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
     is_inv = op == 0xFE
     first_inv = m_halt & is_inv & (sf.inv_pc < 0)
     first_sd = m_halt & is_sd & (sf.sd_pc < 0)
+
+    # SELFDESTRUCT balance sweep (reference: selfdestruct_ transfer
+    # ⚠unv): a CONCRETE beneficiary in the account table is credited and
+    # the executing account zeroed; a symbolic/unknown beneficiary only
+    # zeroes self (funds leave the modeled world) — the epoch bump makes
+    # later BALANCE reads fresh leaves either way, never a stale value.
+    fb = sf.base
+    m_sd = m_halt & is_sd
+    ben_found, ben_slot = fb.acct_lookup(a[0])
+    sweep = m_sd & (s[0] == 0) & ben_found & (ben_slot != fb.cur_acct)
+    lanes_sd = jnp.arange(fb.pc.shape[0])
+    A_sd = fb.acct_used.shape[1]
+    ben_bal = fb.acct_field(fb.acct_bal, ben_slot)
+    self_bal = fb.self_balance
+    acct_bal_sd = fb.acct_bal.at[
+        lanes_sd, jnp.where(sweep, ben_slot, A_sd)].set(
+        u256.add(ben_bal, self_bal), mode="drop")
+    acct_bal_sd = acct_bal_sd.at[
+        lanes_sd, jnp.where(m_sd, fb.cur_acct, A_sd)].set(
+        jnp.zeros_like(self_bal), mode="drop")
+    sf = sf.replace(base=fb.replace(acct_bal=acct_bal_sd),
+                    bal_epoch=sf.bal_epoch + m_sd.astype(I32))
+
     sf = sf.replace(
         rv_sym=rv_sym,
         sd_to_sym=jnp.where(m_halt & is_sd, s[0], sf.sd_to_sym),

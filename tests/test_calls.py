@@ -341,3 +341,28 @@ def test_static_frame_blocks_symbolic_offset_log():
     for lane in np.where(act)[0]:
         st = storage_of(out, lane)
         assert st.get((ACCT_CONTRACT0, 1)) == 0, "static LOG must fail"
+
+
+def test_selfdestruct_sweeps_balance_to_beneficiary():
+    # SELFDESTRUCT(callee addr known in the table): executing account's
+    # balance moves to the beneficiary, self zeroes (reference:
+    # selfdestruct_ transfer semantics)
+    caller = assemble(("push3", ADDR1), "SELFDESTRUCT")
+    callee = assemble("STOP")  # just a known account to be credited
+    out = run_pair(caller, callee)
+    bal = np.asarray(out.base.acct_bal)
+    assert u256.to_int(bal[0, ACCT_CONTRACT0]) == 0, "self swept"
+    assert u256.to_int(bal[0, ACCT_CONTRACT0 + 1]) == 2 * 10**18, \
+        "beneficiary credited"
+    assert bool(np.asarray(out.base.selfdestructed)[0])
+
+
+def test_selfdestruct_symbolic_beneficiary_only_zeroes_self():
+    # symbolic beneficiary: funds leave the modeled world, no spurious
+    # table credit from garbage limbs
+    caller = assemble(0, "CALLDATALOAD", "SELFDESTRUCT")
+    callee = assemble("STOP")
+    out = run_pair(caller, callee)
+    bal = np.asarray(out.base.acct_bal)
+    assert u256.to_int(bal[0, ACCT_CONTRACT0]) == 0
+    assert u256.to_int(bal[0, ACCT_CONTRACT0 + 1]) == 10**18, "unchanged"

@@ -101,3 +101,19 @@ def test_orchestration_creation_path():
     assert analyzer.sym is not None
     assert len(analyzer.sym.tx_contexts) == 2  # creation + 1 message tx
     assert report.contract_name == "Owned"
+
+
+def test_analyze_jsonv2(capsys):
+    rc, out = run_cli(
+        capsys, "analyze", "-c", KILLABLE, "-t", "1",
+        "--max-steps", "64", "--lanes-per-contract", "4",
+        "--limits-profile", "test",
+        "-m", "AccidentallyKillable", "-o", "jsonv2",
+    )
+    assert rc == 0
+    doc = json.loads(out)
+    assert isinstance(doc, list) and doc[0]["sourceType"] == "raw-bytecode"
+    issues = doc[0]["issues"]
+    assert issues and issues[0]["swcID"] == "SWC-106"
+    assert "head" in issues[0]["description"]
+    assert issues[0]["locations"][0]["sourceMap"].count(":") == 2
