@@ -90,5 +90,7 @@ class SignatureDB:
         return list(self._by_sel.get(sel, []))
 
     def save(self, path: Optional[str] = None) -> None:
+        if not (path or self.path):
+            raise ValueError("SignatureDB.save: no path configured")
         with open(path or self.path, "w") as fh:
             json.dump(self._by_sel, fh, indent=1, sort_keys=True)
