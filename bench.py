@@ -27,24 +27,32 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-
-import mythril_tpu  # noqa: F401  (enables x64)
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from mythril_tpu.config import DEFAULT_LIMITS
-from mythril_tpu.core import run
-from mythril_tpu.disassembler.asm import abi_call, erc20_like
-from mythril_tpu.workloads import (
-    BENCH_CALLER as CALLER,
-    TRANSFER_SELECTOR,
-    erc20_transfer_workload,
-)
-
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
-from pyevm_ref import RefEVM, RefEnv  # noqa: E402
+
+# NO jax-touching imports at module level: importing mythril_tpu.core
+# builds jnp tables, which INITIALIZES the backend — on a wedged TPU
+# runtime that hangs before the probe can run (this is exactly how the
+# round-3 driver bench died). Everything heavy loads in _lazy_imports()
+# AFTER _probe_backend() has proven the backend comes up.
+
+
+def _lazy_imports():
+    global mythril_tpu, jax, jnp, np, DEFAULT_LIMITS, run
+    global abi_call, erc20_like, CALLER, TRANSFER_SELECTOR
+    global erc20_transfer_workload, RefEVM, RefEnv
+    import mythril_tpu  # noqa: F401  (enables x64)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mythril_tpu.config import DEFAULT_LIMITS
+    from mythril_tpu.core import run
+    from mythril_tpu.disassembler.asm import abi_call, erc20_like
+    from mythril_tpu.workloads import (
+        BENCH_CALLER as CALLER,
+        TRANSFER_SELECTOR,
+        erc20_transfer_workload,
+    )
+    from pyevm_ref import RefEVM, RefEnv
 
 P = 4096  # lanes (concrete bench)
 MAX_STEPS = 256
@@ -166,30 +174,158 @@ def bench_analyze() -> dict:
     }
 
 
-def main():
-    value, vs, err = bench_concrete()
-    if err:
-        print(json.dumps({"metric": "lane_steps_per_sec", "value": 0.0,
-                          "unit": "steps/s", "vs_baseline": 0.0, "error": err}))
-        return
-    extra = {}
-    try:
-        extra.update(bench_symbolic())
-    except Exception as e:  # never lose the headline number
-        extra["sym_error"] = repr(e)[:200]
-    try:
-        extra.update(bench_analyze())
-    except Exception as e:
-        extra["analyze_error"] = repr(e)[:200]
+def bench_profile() -> dict:
+    """Superstep time breakdown (VERDICT r3 ask #1b): per-variant dispatch
+    cost + bandwidth floor, via tools/profile_superstep.py in a subprocess
+    (its extra XLA programs must not crowd this process's compile budget)."""
+    import subprocess
 
-    print(json.dumps({
+    env = dict(os.environ)
+    env.setdefault("PROF_P", str(P))
+    env.setdefault("PROF_STEPS", str(MAX_STEPS))
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                      "tools", "profile_superstep.py")],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
+    prof = json.loads(line)
+    prof.pop("backend", None)
+    return {"profile": prof}
+
+
+def _emit(value, vs, unit_note, extra, error=None):
+    rec = {
         "metric": "lane_steps_per_sec",
-        "value": round(value, 1),
-        "unit": "opcode-steps/s (P=%d lanes, ERC20 transfer)" % P,
-        "vs_baseline": round(vs, 2),
+        "value": round(float(value), 1),
+        "unit": "opcode-steps/s (%s)" % unit_note,
+        "vs_baseline": round(float(vs), 2),
         "extra": extra,
-    }))
+    }
+    if error:
+        rec["error"] = str(error)[:400]
+    print(json.dumps(rec))
+
+
+def _probe_backend(timeout_s: float = 75.0, retries: int = 2):
+    """Initialize the JAX backend in a SUBPROCESS with a timeout, so a hung
+    TPU runtime (round 3: driver bench + judge re-run both hung >590 s in
+    backend init) cannot take this process down with it. A child wedged in
+    an uninterruptible driver call survives SIGKILL — it is ABANDONED, not
+    waited on (subprocess.run would block forever in wait()). Returns
+    (ok, diagnosis)."""
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    diag = ""
+    for attempt in range(retries):
+        with tempfile.TemporaryFile(mode="w+") as out:
+            p = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import sys; sys.path.insert(0, %r); " % here
+                 + "import mythril_tpu, jax; d = jax.devices(); "
+                   "print('OK', jax.default_backend(), len(d))"],
+                stdout=out, stderr=subprocess.STDOUT,
+            )
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if p.poll() is not None:
+                    break
+                time.sleep(0.5)
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass  # unkillable (D-state): abandon it
+                diag = "backend init hung >%ds (attempt %d/%d)" % (
+                    timeout_s, attempt + 1, retries)
+                continue
+            out.seek(0)
+            text = out.read()
+            if p.returncode == 0 and "OK" in text:
+                return True, text.strip().splitlines()[-1]
+            diag = "backend init failed (rc=%s): %s" % (
+                p.returncode, text.strip()[-300:])
+    return False, diag
+
+
+def _cpu_fallback(diag: str) -> None:
+    """TPU unreachable: re-run this benchmark on the CPU backend with small
+    shapes so the driver still records a parsed JSON line. The numbers are
+    labeled — a CPU-backend vectorized-vs-scalar ratio, NOT comparable to
+    TPU rounds."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    # concrete only: sym_run/fire_lasers XLA compiles take minutes on a CPU
+    # backend and would blow the driver's remaining time budget
+    env.update(JAX_PLATFORMS="cpu", MYTHRIL_BENCH_SMALL="1",
+               MYTHRIL_BENCH_NO_PROBE="1", MYTHRIL_BENCH_NO_PROFILE="1",
+               MYTHRIL_BENCH_NO_ANALYZE="1", MYTHRIL_BENCH_NO_SYM="1")
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True, timeout=360, env=env)
+        rec = json.loads(r.stdout.strip().splitlines()[-1])
+        extra = rec.get("extra", {})
+        extra["platform"] = "cpu-fallback"
+        extra["tpu_error"] = diag[:300]
+        _emit(rec.get("value", 0.0), rec.get("vs_baseline", 0.0),
+              "CPU-FALLBACK " + rec.get("unit", ""), extra,
+              error="tpu backend unavailable: " + diag)
+    except Exception as e:
+        _emit(0.0, 0.0, "no backend", {"tpu_error": diag[:300]},
+              error="tpu unavailable (%s); cpu fallback also failed: %r"
+                    % (diag[:200], e))
+
+
+def main():
+    global P, MAX_STEPS, SYM_P, SYM_MAX_STEPS, ANALYZE_CONTRACTS
+    if os.environ.get("MYTHRIL_BENCH_SMALL"):
+        P, MAX_STEPS, SYM_P, SYM_MAX_STEPS = 1024, 192, 1024, 128
+        ANALYZE_CONTRACTS = 8
+
+    if not os.environ.get("MYTHRIL_BENCH_NO_PROBE"):
+        ok, diag = _probe_backend()
+        if not ok:
+            _cpu_fallback(diag)
+            return
+
+    _lazy_imports()
+    try:
+        value, vs, err = bench_concrete()
+    except Exception as e:
+        _emit(0.0, 0.0, "P=%d lanes, ERC20 transfer" % P, {}, error=repr(e)[:300])
+        return
+    if err:
+        _emit(0.0, 0.0, "P=%d lanes, ERC20 transfer" % P, {}, error=err)
+        return
+    extra = {"platform": jax.default_backend()}
+    if not os.environ.get("MYTHRIL_BENCH_NO_SYM"):
+        try:
+            extra.update(bench_symbolic())
+        except Exception as e:  # never lose the headline number
+            extra["sym_error"] = repr(e)[:200]
+    if not os.environ.get("MYTHRIL_BENCH_NO_ANALYZE"):
+        try:
+            extra.update(bench_analyze())
+        except Exception as e:
+            extra["analyze_error"] = repr(e)[:200]
+    if not os.environ.get("MYTHRIL_BENCH_NO_PROFILE"):
+        try:
+            extra.update(bench_profile())
+        except Exception as e:
+            extra["profile_error"] = repr(e)[:200]
+    _emit(value, vs, "P=%d lanes, ERC20 transfer" % P, extra)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # the one-JSON-line contract is absolute
+        print(json.dumps({"metric": "lane_steps_per_sec", "value": 0.0,
+                          "unit": "opcode-steps/s", "vs_baseline": 0.0,
+                          "error": "unhandled: %r" % (e,)}))
+        raise SystemExit(0)

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Superstep profiler: where does concrete-interpreter time go?
+
+Times, on the current default backend:
+  - full `run` (per-superstep cost on the ERC-20 workload),
+  - prologue / epilogue alone,
+  - each class handler standalone (all lanes executing that class),
+  - the 16 `jnp.any(mask)` dispatch predicates,
+so the dispatch restructuring (VERDICT r3 "Next round" #1) is driven by
+measurements instead of guesses. Prints ONE JSON object.
+
+Run in its own process (the XLA:CPU JIT segfault appears after ~50 large
+compiles in one process — see pytest.ini).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mythril_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mythril_tpu.config import DEFAULT_LIMITS
+from mythril_tpu.core import run
+from mythril_tpu.core import interpreter as ci
+from mythril_tpu.workloads import erc20_transfer_workload
+
+P = int(os.environ.get("PROF_P", "4096"))
+MAX_STEPS = int(os.environ.get("PROF_STEPS", "256"))
+REPS = int(os.environ.get("PROF_REPS", "20"))
+
+CLASS_NAMES = [
+    "STACK", "ALU", "MUL", "DIVMOD", "MODARITH", "EXP", "SHA3", "ENV",
+    "COPY", "MEM", "STORAGE", "JUMP", "HALT", "LOG", "CALL", "CREATE",
+]
+
+# a representative opcode per class to fill the op vector with
+CLASS_OP = {
+    "STACK": 0x60, "ALU": 0x01, "MUL": 0x02, "DIVMOD": 0x04,
+    "MODARITH": 0x08, "EXP": 0x0A, "SHA3": 0x20, "ENV": 0x33,
+    "COPY": 0x37, "MEM": 0x51, "STORAGE": 0x54, "JUMP": 0x56,
+    "HALT": 0x00, "LOG": 0xA1, "CALL": 0xF1, "CREATE": 0xF0,
+}
+
+
+def timed(fn, *args, reps=REPS):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def tree_bytes(t) -> int:
+    return sum(x.nbytes for x in jax.tree.leaves(t) if hasattr(x, "nbytes"))
+
+
+def main():
+    code, f, env, corpus = erc20_transfer_workload(P, DEFAULT_LIMITS)
+    res = {"backend": jax.default_backend(), "P": P, "max_steps": MAX_STEPS,
+           "frontier_bytes": tree_bytes(f), "corpus_bytes": tree_bytes(corpus)}
+
+    from jax import lax
+
+    def make_runner(cond_classes, skeleton=False):
+        def step(fr):
+            fr, op, run_m, old_pc = ci.prologue(fr, corpus)
+            if not skeleton:
+                fr = ci.dispatch(fr, env, corpus, op, run_m, old_pc,
+                                 cond_classes=cond_classes)
+            return ci.epilogue(fr, op, run_m, old_pc)
+
+        @jax.jit
+        def go(fr):
+            def cond(st):
+                i, x = st
+                return (i < MAX_STEPS) & jnp.any(x.running)
+
+            def body(st):
+                i, x = st
+                return i + 1, step(x)
+
+            return lax.while_loop(cond, body, (jnp.int32(0), fr))[1]
+
+        return go
+
+    variants = {
+        "split": tuple(ci.COND_CLASSES),          # new: cheap classes fused
+        "all_cond": tuple(range(ci.N_CLASSES)),   # round-3 behavior
+        "none_cond": (),                          # everything unconditional
+    }
+    prof = {}
+    out = None
+    for name, cc in variants.items():
+        runner = make_runner(cc)
+        dt = timed(runner, f, reps=5)
+        out = runner(f)
+        steps = int(np.asarray(out.n_steps).max())
+        prof[f"{name}_wall_s"] = round(dt, 4)
+        prof[f"{name}_superstep_ms"] = round(dt / max(steps, 1) * 1e3, 4)
+    sk = make_runner((), skeleton=True)
+    dt = timed(sk, f, reps=5)
+    prof["skeleton_superstep_ms"] = round(dt / MAX_STEPS * 1e3, 4)
+
+    steps_sum = int(np.asarray(out.n_steps).sum())
+    supersteps = int(np.asarray(out.n_steps).max())
+    dt = prof["split_wall_s"]
+    res["supersteps"] = supersteps
+    res["lane_steps_per_sec"] = round(steps_sum / dt, 1)
+    # bandwidth floor: each superstep reads+writes the frontier once
+    res["est_min_GBps"] = round(
+        2 * res["frontier_bytes"] * supersteps / dt / 1e9, 2)
+    res["profile"] = prof
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
