@@ -30,12 +30,16 @@ class LimitsConfig:
     tape_len: int = 512  # symbolic SSA tape nodes per lane
     max_constraints: int = 128  # path-condition slots per lane
     call_depth: int = 4  # saved call contexts per lane
+    init_code_bytes: int = 1024  # in-tx CREATE/CREATE2 init-code buffer per
+    # lane (longer init code falls back to the codeless-account path)
     call_log: int = 16  # recorded external-call events per lane
     arith_log: int = 32  # recorded symbolic-arithmetic events per lane
     propagate_every: int = 8  # supersteps between feasibility sweeps
     loop_bound: int = 8  # max taken backward jumps to one target per lane
     # (0 disables; reference: BoundedLoopsStrategy --loop-bound ⚠unv)
     loop_slots: int = 8  # tracked distinct back-jump targets per lane
+    gas_schedule: str = "istanbul"  # "istanbul" (reference-era static
+    # table) or "berlin" (EIP-2929 warm/cold access accounting)
 
     def __post_init__(self):
         assert self.max_stack >= 17  # SWAP16 arity
@@ -58,6 +62,7 @@ TEST_LIMITS = LimitsConfig(
     tape_len=128,
     max_constraints=32,
     call_depth=2,
+    init_code_bytes=256,
     call_log=4,
     arith_log=8,
     propagate_every=4,

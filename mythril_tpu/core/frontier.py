@@ -145,6 +145,21 @@ class Frontier:
     fr_st_written: jnp.ndarray  # bool[P, D, K]
     fr_st_acct: jnp.ndarray  # i32[P, D, K]
     fr_acct_bal: jnp.ndarray  # u32[P, D, A, 8]
+    fr_create_slot: jnp.ndarray  # i32[P, D] account slot a CREATE frame is
+    # constructing (-1 = ordinary call frame)
+    fr_gas_limit: jnp.ndarray  # i64[P, D] caller's gas ceiling (EIP-150:
+    # the callee runs under used + min(gas operand, 63/64 remaining))
+    # --- EIP-2929 warm sets (berlin schedule; rolled back with frames) ---
+    warm_acct: jnp.ndarray  # bool[P, A] account touched this tx
+    st_warm: jnp.ndarray  # bool[P, K] storage-cache slot touched this tx
+    fr_warm_acct: jnp.ndarray  # bool[P, D, A]
+    fr_st_warm: jnp.ndarray  # bool[P, D, K]
+    # --- in-tx CREATE init-code execution (one live init frame per lane;
+    # a constructor's own nested CREATE falls back to the codeless path) ---
+    init_code: jnp.ndarray  # u8[P, IC] init code being executed
+    init_len: jnp.ndarray  # i32[P]
+    init_jd: jnp.ndarray  # bool[P, IC] jumpdest map of the init buffer
+    init_depth: jnp.ndarray  # i32[P] frame depth running init code (0 = none)
     # --- per-lane world state (reference: WorldState/Account ⚠unv) ---
     acct_addr: jnp.ndarray  # u32[P, A, 8]
     acct_code: jnp.ndarray  # i32[P, A] corpus index (-1 = EOA / no code;
@@ -198,6 +213,13 @@ class Frontier:
     def running(self) -> jnp.ndarray:
         """Lanes that still execute: active and not halted/errored."""
         return self.active & ~self.halted & ~self.error
+
+    @property
+    def exec_init(self) -> jnp.ndarray:
+        """Lanes whose CURRENT frame executes CREATE init code (opcode
+        fetch, PUSH immediates, CODESIZE/CODECOPY and JUMPDEST validation
+        read the per-lane ``init_code`` buffer instead of the corpus)."""
+        return (self.init_depth > 0) & (self.depth == self.init_depth)
 
     def trap(self, mask, code: int) -> "Frontier":
         """Set the error flag under ``mask``, attributing the FIRST cause."""
@@ -382,6 +404,20 @@ def make_frontier(
         fr_st_written=jnp.zeros((P, D, L.storage_slots), dtype=bool),
         fr_st_acct=jnp.zeros((P, D, L.storage_slots), dtype=jnp.int32),
         fr_acct_bal=z8(P, D, A),
+        fr_create_slot=jnp.full((P, D), -1, dtype=jnp.int32),
+        fr_gas_limit=jnp.zeros((P, D), dtype=jnp.int64),
+        # tx-start warm set: origin/caller + the executing account
+        # (EIP-2929 pre-warms tx.origin and tx.to)
+        warm_acct=jnp.zeros((P, A), dtype=bool)
+        .at[jnp.arange(P), ACCT_ATTACKER].set(True)
+        .at[jnp.arange(P), jnp.asarray(cur_acct, dtype=jnp.int32)].set(True),
+        st_warm=jnp.zeros((P, L.storage_slots), dtype=bool),
+        fr_warm_acct=jnp.zeros((P, D, A), dtype=bool),
+        fr_st_warm=jnp.zeros((P, D, L.storage_slots), dtype=bool),
+        init_code=jnp.zeros((P, L.init_code_bytes), dtype=jnp.uint8),
+        init_len=jnp.zeros(P, dtype=jnp.int32),
+        init_jd=jnp.zeros((P, L.init_code_bytes), dtype=bool),
+        init_depth=jnp.zeros(P, dtype=jnp.int32),
         acct_addr=jnp.asarray(addr),
         acct_code=jnp.asarray(code),
         acct_bal=jnp.asarray(bal),

@@ -82,6 +82,8 @@ _J_STACK_IN = jnp.asarray(oc.STACK_IN)
 _J_STACK_OUT = jnp.asarray(oc.STACK_OUT)
 _J_GAS_MIN = jnp.asarray(oc.GAS_MIN)
 _J_GAS_MAX = jnp.asarray(oc.GAS_MAX)
+_J_GAS_MIN_BERLIN = jnp.asarray(oc.GAS_MIN_BERLIN)
+_J_GAS_MAX_BERLIN = jnp.asarray(oc.GAS_MAX_BERLIN)
 _J_PUSH_WIDTH = jnp.asarray(oc.PUSH_WIDTH)
 _J_IS_VALID = jnp.asarray(oc.IS_VALID)
 _J_CLASS = jnp.asarray(CLASS_TABLE)
@@ -197,6 +199,9 @@ def _h_stack(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     code_row = corpus.code[f.contract_id]  # u8[P, MC]
     code_len = corpus.code_len[f.contract_id]
     raw = _gather_bytes(code_row, old_pc + 1, 32, code_len)  # u8[P,32]
+    ei = f.exec_init
+    raw_ini = _gather_bytes(f.init_code, old_pc + 1, 32, f.init_len)
+    raw = jnp.where(ei[:, None], raw_ini, raw)
     j = jnp.arange(32)
     sig = width[:, None] - 1 - j[None, :]  # byte significance (bytes); <0 = beyond width
     in_range = sig >= 0
@@ -343,7 +348,9 @@ def _h_sha3(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
 
 def _h_env(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     a = _peek(f, 0)  # operand for the 1-in ops
-    code_len = corpus.code_len[f.contract_id]
+    # CODESIZE inside a constructor is the INIT code's size
+    code_len = jnp.where(f.exec_init, f.init_len,
+                         corpus.code_len[f.contract_id])
 
     cd_load = _be_bytes_to_word(
         _gather_bytes(f.calldata, u256.to_u64_saturating(a).astype(I64), 32, f.calldata_len)
@@ -412,6 +419,13 @@ def _h_copy(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     cd = _take_per_lane(f.calldata, sidx, f.calldata_len.astype(I64))
     code_row = corpus.code[f.contract_id]
     code = _take_per_lane(code_row, sidx, corpus.code_len[f.contract_id].astype(I64))
+    # CODECOPY inside a constructor copies from the INIT code (this is how
+    # constructors materialize the runtime image they RETURN)
+    code = jnp.where(
+        f.exec_init[:, None],
+        _take_per_lane(f.init_code, sidx, f.init_len.astype(I64)),
+        code,
+    )
     rd = _take_per_lane(f.returndata, sidx, f.returndata_len.astype(I64))
     # EXTCODECOPY: resolve the address against the account table; unknown
     # or codeless accounts copy zeros (EVM: empty code)
@@ -503,14 +517,19 @@ def storage_alloc(f: Frontier, hit, hit_slot, m_store):
 
 def validate_jump_dest(f: Frontier, corpus: Corpus, dest_w):
     """(dest i64[P], valid bool[P]): saturating target + JUMPDEST check.
-    Shared by the concrete and symbolic jump handlers."""
+    Shared by the concrete and symbolic jump handlers. Init frames check
+    against the per-lane init-buffer jumpdest map."""
     dest = u256.to_u64_saturating(dest_w).astype(I64)
     MC = corpus.code.shape[1]
     idx = jnp.clip(dest, 0, MC - 1).astype(I32)
     valid = (dest < MC) & jnp.take_along_axis(
         corpus.is_jumpdest[f.contract_id], idx[:, None], axis=1
     )[:, 0]
-    return dest, valid
+    IC = f.init_jd.shape[1]
+    valid_ini = (dest < IC) & jnp.take_along_axis(
+        f.init_jd, jnp.clip(dest, 0, IC - 1).astype(I32)[:, None], axis=1
+    )[:, 0]
+    return dest, jnp.where(f.exec_init, valid_ini, valid)
 
 
 def _h_storage(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -661,7 +680,7 @@ _HANDLERS = [
 # ---------------------------------------------------------------------------
 
 
-def prologue(f: Frontier, corpus: Corpus):
+def prologue(f: Frontier, corpus: Corpus, berlin: bool = False):
     """Fetch + validate the next instruction for every running lane.
 
     Returns ``(f, op, run, old_pc)``: frontier with arity/validity traps and
@@ -669,12 +688,23 @@ def prologue(f: Frontier, corpus: Corpus):
     that execute this step, and the pre-step pc. Shared by the concrete
     superstep and the symbolic engine (reference: the ``StateTransition``
     decorator checks in ``mythril/laser/ethereum/instructions.py`` ⚠unv).
+    ``berlin`` charges the EIP-2929 WARM base costs — the symbolic engine
+    adds cold surcharges from its per-lane warm sets.
     """
     running = f.running
     MC = corpus.code.shape[1]
     pc_idx = jnp.clip(f.pc, 0, MC - 1)
     op_raw = jnp.take_along_axis(corpus.code[f.contract_id], pc_idx[:, None], axis=1)[:, 0]
     in_code = f.pc < corpus.code_len[f.contract_id]
+    # CREATE init frames fetch from the per-lane init buffer (a single-byte
+    # per-lane gather — cheap enough to run unconditionally)
+    ei = f.exec_init
+    IC = f.init_code.shape[1]
+    op_ini = jnp.take_along_axis(
+        f.init_code, jnp.clip(f.pc, 0, IC - 1)[:, None], axis=1
+    )[:, 0]
+    op_raw = jnp.where(ei, op_ini, op_raw)
+    in_code = jnp.where(ei, f.pc < f.init_len, in_code)
     op = jnp.where(running & in_code, op_raw, 0).astype(I32)  # off-end = STOP
 
     sin = _J_STACK_IN[op]
@@ -688,28 +718,62 @@ def prologue(f: Frontier, corpus: Corpus):
     f = f.trap(invalid, Trap.INVALID_OP).trap(stack_bad, Trap.STACK)
     run = running & ~invalid & ~stack_bad
 
+    gmin = _J_GAS_MIN_BERLIN if berlin else _J_GAS_MIN
+    gmax = _J_GAS_MAX_BERLIN if berlin else _J_GAS_MAX
     f = f.replace(
-        gas_min=f.gas_min + jnp.where(run, _J_GAS_MIN[op], 0),
-        gas_max=f.gas_max + jnp.where(run, _J_GAS_MAX[op], 0),
+        gas_min=f.gas_min + jnp.where(run, gmin[op], 0),
+        gas_max=f.gas_max + jnp.where(run, gmax[op], 0),
     )
     return f, op, run, f.pc
 
 
+# Classes whose handlers are cheap elementwise work can be applied
+# UNCONDITIONALLY every superstep (their lane mask already makes them a
+# no-op for other lanes), so XLA fuses them into one pass over the
+# frontier instead of materializing it at 16 `lax.cond` boundaries.
+# Classes with big inner loops (256-step division/exp, keccak rounds) or
+# whole-memory-window traffic stay behind `lax.cond` — a superstep must
+# not pay for them when no lane needs them.
+#
+# The right split is BACKEND-DEPENDENT (tools/profile_superstep.py):
+# on XLA:CPU conds are nearly free and fusion across handlers is weak, so
+# gating everything wins (5.3 vs 9.0 ms/superstep at P=1024); on TPU each
+# cond is a fusion barrier that forces a full-frontier materialization,
+# so the cheap classes fuse. Resolved once at first trace.
+COND_CLASSES = (CLS_MUL, CLS_DIVMOD, CLS_MODARITH, CLS_EXP, CLS_SHA3, CLS_COPY)
+
+
+def default_cond_classes() -> tuple:
+    if jax.default_backend() == "cpu":
+        return tuple(range(N_CLASSES))
+    return COND_CLASSES
+
+
 def dispatch(f: Frontier, env: Env, corpus: Corpus, op, run, old_pc,
-             skip=None) -> Frontier:
+             skip=None, cond_classes=None) -> Frontier:
     """Run the per-class handlers over the frontier. ``skip`` masks lanes
     out of concrete handling (the symbolic engine claims them)."""
+    if cond_classes is None:
+        cond_classes = default_cond_classes()
     cls = _J_CLASS[op]
     if skip is not None:
         run = run & ~skip
+    # one O(P) pass computing every class-present predicate at once,
+    # instead of one whole-frontier `jnp.any` reduction per gated class
+    present = jax.ops.segment_sum(
+        run.astype(I32), cls, num_segments=N_CLASSES, indices_are_sorted=False
+    ) > 0
     for cid, handler in enumerate(_HANDLERS):
         mask = run & (cls == cid)
-        f = lax.cond(
-            jnp.any(mask),
-            lambda fr, h=handler, mk=mask: h(fr, env, corpus, op, mk, old_pc),
-            lambda fr: fr,
-            f,
-        )
+        if cid in cond_classes:
+            f = lax.cond(
+                present[cid],
+                lambda fr, h=handler, mk=mask: h(fr, env, corpus, op, mk, old_pc),
+                lambda fr: fr,
+                f,
+            )
+        else:
+            f = handler(f, env, corpus, op, mask, old_pc)
     return f
 
 

@@ -189,6 +189,30 @@ for _code, _info in OPCODES.items():
     PUSH_WIDTH[_code] = _info.push_width
     IS_VALID[_code] = True
 
+# EIP-2929 (Berlin) static tables: state-access opcodes carry their WARM
+# cost here; the symbolic engine adds the cold surcharge dynamically from
+# its per-lane warm sets (see engine._berlin_gas_fixup). Reference keeps
+# an Istanbul-era schedule (SURVEY §2 "Gas/opcode metadata"); the rebuild
+# supports both via LimitsConfig.gas_schedule.
+G_WARM_ACCESS = 100
+G_COLD_SLOAD = 2100
+G_COLD_ACCOUNT = 2600
+
+GAS_MIN_BERLIN = GAS_MIN.copy()
+GAS_MAX_BERLIN = GAS_MAX.copy()
+for _c in (0x31, 0x3B, 0x3C, 0x3F):  # BALANCE EXTCODESIZE EXTCODECOPY EXTCODEHASH
+    GAS_MIN_BERLIN[_c] = GAS_MIN[_c] - _G_EXTCODE + G_WARM_ACCESS
+    GAS_MAX_BERLIN[_c] = GAS_MAX[_c] - _G_EXTCODE + G_WARM_ACCESS
+GAS_MIN_BERLIN[0x54] = G_WARM_ACCESS                 # SLOAD
+GAS_MAX_BERLIN[0x54] = G_WARM_ACCESS
+GAS_MIN_BERLIN[0x55] = 100                           # SSTORE warm dirty
+GAS_MAX_BERLIN[0x55] = 20000                         # fresh slot write
+for _c in (0xF1, 0xF2, 0xF4, 0xFA):                  # CALL family
+    GAS_MIN_BERLIN[_c] = GAS_MIN[_c] - _G_CALL + G_WARM_ACCESS
+    GAS_MAX_BERLIN[_c] = GAS_MAX[_c] - _G_CALL + G_WARM_ACCESS
+GAS_MIN_BERLIN[0xFF] = G_WARM_ACCESS + 4900          # SELFDESTRUCT (5000 kept)
+GAS_MAX_BERLIN[0xFF] = GAS_MAX[0xFF]
+
 # Halting / control metadata for the interpreter & CFG builder
 HALTS = np.zeros(256, dtype=bool)  # STOP RETURN REVERT INVALID SELFDESTRUCT
 for _c in (0x00, 0xF3, 0xFD, 0xFE, 0xFF):

@@ -32,7 +32,8 @@ from jax import lax
 from ..config import LimitsConfig, DEFAULT_LIMITS
 from ..core import interpreter as ci
 from ..core.frontier import (Frontier, Env, Corpus, Trap, CAP_TRAPS,
-                             KILL_TRAPS, ATTACKER_ADDRESS, CODE_UNKNOWN)
+                             KILL_TRAPS, ACCT_ATTACKER, ATTACKER_ADDRESS,
+                             CODE_UNKNOWN)
 from ..ops import u256
 from .ops import SymOp, FreeKind, TX_STRIDE, BAL_STRIDE
 from .state import SymFrontier, SymSpec
@@ -331,9 +332,9 @@ def _h_sym_jump(sf: SymFrontier, corpus: Corpus, op, m, old_pc, known, ksign) ->
     )
 
 
-def _note_backjump(sf: SymFrontier, mask, dest, loop_bound: int) -> SymFrontier:
-    """Count taken BACKWARD jumps per (lane, contract, target); retire
-    lanes whose revisit count exceeds ``loop_bound``.
+def _note_backjump(sf: SymFrontier, mask, src, dest, loop_bound: int) -> SymFrontier:
+    """Count taken BACKWARD jumps per (lane, contract, source pc, target);
+    retire lanes whose revisit count exceeds ``loop_bound``.
 
     The frontier analog of the reference's ``BoundedLoopsStrategy``
     (``strategy/extensions/bounded_loops.py`` ⚠unv, SURVEY.md §1 row 7):
@@ -342,11 +343,17 @@ def _note_backjump(sf: SymFrontier, mask, dest, loop_bound: int) -> SymFrontier:
     bound traps with ``Trap.LOOP_BOUND`` — freeing its slot and its step
     budget for other paths instead of burning ``max_steps`` for the whole
     frontier. A miss on a full table reuses the coldest slot (heuristic:
-    the hot loop is by definition the one being revisited)."""
+    the hot loop is by definition the one being revisited).
+
+    The key includes the JUMP's own pc: a shared subroutine placed before
+    its call sites is entered via *distinct* backward jumps, which must
+    not pool into one counter — only a repeated (src, dest) edge is a
+    loop iteration."""
     if loop_bound <= 0:
         return sf
     P, LBS = sf.lb_key.shape
-    key = (sf.base.contract_id * 32768 + dest).astype(I32)
+    key = ((sf.base.contract_id.astype(jnp.int64) * 32768 + dest) * 32768
+           + src)
     live = jnp.arange(LBS)[None, :] < sf.lb_len[:, None]
     match = live & (sf.lb_key == key[:, None])
     hit = jnp.any(match, axis=1)
@@ -478,7 +485,85 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
     )
     internal = resolvable & (callee_code >= 0)
     eoa = resolvable & (callee_code == -1)  # CODE_UNKNOWN (-2) -> external
-    external = m & ~internal & ~eoa & ~pre
+
+    # --- symbolic-callee enumeration (VERDICT r3 ask #2; reference:
+    # ``call.py get_call_parameters`` resolving a symbolic callee via
+    # constraints ⚠unv, SURVEY §3.2). A CALL whose target word is
+    # symbolic — every proxy/registry pattern — forks ONE candidate
+    # account per superstep instead of havocking: the fork copy
+    # re-executes this CALL with the target stack slot concretized to
+    # acct_addr[k] under the path constraint to == addr_k (expand_forks
+    # flips the appended constraint sign for the copy and applies the
+    # fork_cslot/fork_cval concretization); the staying lane accumulates
+    # ¬(to == addr_k) and, once the table is exhausted, falls through to
+    # the external-havoc path carrying "to != every known account".
+    # Symbolic value / symbolic windows / exhausted depth still havoc.
+    A_n = f.acct_used.shape[1]
+    enumable = (
+        m & (to_sym != 0) & conc_windows & value_conc
+        & (f.depth < D) & (a_len <= CD)
+    )
+    k_cand = jnp.clip(sf.call_enum, 0, A_n - 1)
+    cand_valid = sf.call_enum < A_n
+    slot_used = jnp.take_along_axis(f.acct_used, k_cand[:, None], axis=1)[:, 0]
+    enum_spawn = (enumable & cand_valid & slot_used
+                  & (sf.con_len < sf.con_node.shape[1]))
+    # a GAP in the table (e.g. a reverted create unregistered its slot)
+    # advances the scan without spawning; exhausted counter (or a full
+    # constraint store) resolves to the external fallback
+    enum_skip = enumable & cand_valid & ~slot_used
+    enum_done = enumable & ~enum_spawn & ~enum_skip
+    enum_hold = enum_spawn | enum_skip
+    cand_addr = f.acct_field(f.acct_addr, k_cand)
+    sf, caddr_id = append_node(sf, enum_spawn, int(SymOp.CONST), 0, 0,
+                               cand_addr)
+    sf, eq_id = append_node(sf, enum_spawn, int(SymOp.EQ), to_sym, caddr_id)
+    sf = _append_constraint(sf, enum_spawn, eq_id, False, old_pc)
+    sf = sf.replace(
+        call_enum=jnp.where(enum_hold, sf.call_enum + 1,
+                            jnp.where(enum_done, 0, sf.call_enum)),
+        fork_req=sf.fork_req | enum_spawn,
+        fork_dest=jnp.where(enum_spawn, old_pc, sf.fork_dest),
+        fork_cslot=jnp.where(enum_spawn, f.sp - 2, sf.fork_cslot),
+        fork_cval=jnp.where(enum_spawn[:, None], cand_addr, sf.fork_cval),
+    )
+    f = sf.base
+
+    # a parked lane re-executes this CALL next superstep — the prologue's
+    # base charge must not accumulate once per retry
+    berlin = limits.gas_schedule == "berlin"
+    gmin_t = ci._J_GAS_MIN_BERLIN if berlin else ci._J_GAS_MIN
+    gmax_t = ci._J_GAS_MAX_BERLIN if berlin else ci._J_GAS_MAX
+    # the static table charges the worst case (value transfer + new
+    # account); refine for concretely-known cases so a fully concrete
+    # call has exact gas (min == max): zero value never pays the 9000
+    # transfer or 25000 new-account surcharge; a nonzero transfer to an
+    # EXISTING account drops the 25000
+    nonzero_val = has_value & value_conc & ~u256.is_zero(value)
+    zero_val = has_value & value_conc & ~nonzero_val
+    refund = jnp.where(is_call & zero_val, 9000 + 25000, 0)
+    # the existing-account refund needs a CONCRETE target: a symbolic
+    # call's true target can be outside the table (a fresh account that
+    # does pay the 25000) even when its concrete shadow matches a row
+    refund = jnp.where(is_call & nonzero_val & found & (to_sym == 0),
+                       25000, refund)
+    refund = jnp.where((op == 0xF2) & zero_val, 9000, refund)
+    # berlin: a symbolic target that exhausted enumeration resolves here
+    # (external havoc) without ever paying its cold-account surcharge —
+    # its true target is provably outside the (warm-trackable) table
+    ext_cold = 0
+    if berlin:
+        from ..disassembler.opcodes import G_COLD_ACCOUNT, G_WARM_ACCESS
+        ext_sym = m & ~internal & ~eoa & ~pre & ~enum_hold & (to_sym != 0)
+        ext_cold = jnp.where(ext_sym, G_COLD_ACCOUNT - G_WARM_ACCESS, 0)
+    f = f.replace(
+        gas_min=f.gas_min - jnp.where(enum_hold, gmin_t[op], 0),
+        gas_max=f.gas_max + ext_cold
+        - jnp.where(enum_hold, gmax_t[op], jnp.where(m, refund, 0)),
+    )
+    sf = sf.replace(base=f)
+
+    external = m & ~internal & ~eoa & ~pre & ~enum_hold
 
     # memory expansion for the arg/ret windows (charged at call time)
     f = sf.base
@@ -515,9 +600,11 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
     # share leaves with reads before it
     sf = sf.replace(base=f, bal_epoch=sf.bal_epoch + transfer.astype(I32))
 
-    # --- event record for every path (modules consume this)
-    sf = _record_call_event(sf, m, op, old_pc, to.astype(U32), to_sym,
-                            value, value_sym)
+    # --- event record for every path (modules consume this); a lane still
+    # enumerating candidate callees records nothing yet — it records when
+    # it finally resolves (each fork copy re-executes and records its own)
+    sf = _record_call_event(sf, m & ~enum_hold, op, old_pc, to.astype(U32),
+                            to_sym, value, value_sym)
     f = sf.base
 
     # --- external fallback: havoc retval + output region
@@ -550,7 +637,21 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
     # --- frame push for internal calls
     d = f.depth
     mi = internal_go
+    # EIP-150 gas forwarding: the callee runs under
+    # used + min(gas operand, 63/64 * remaining); a symbolic gas operand
+    # forwards the cap (all-but-one-64th). pop_frames restores the
+    # caller's ceiling and, on exceptional failure, burns the whole
+    # forwarded amount (a REVERT keeps only what the callee spent).
+    gas_op = u256.to_u64_saturating(ci._peek(f, 0)).astype(I64)
+    gas_op_sym = _peek_sym(sf, 0)
+    remaining = jnp.maximum(f.gas_limit - f.gas_max, 0)
+    fwd_cap = remaining - remaining // 64
+    fwd = jnp.where(gas_op_sym == 0, jnp.minimum(gas_op, fwd_cap), fwd_cap)
     f2 = f.replace(
+        fr_gas_limit=_fr_set(f.fr_gas_limit, d, f.gas_limit, mi),
+        gas_limit=jnp.where(mi, f.gas_max + fwd, f.gas_limit),
+        fr_warm_acct=_fr_set(f.fr_warm_acct, d, f.warm_acct, mi),
+        fr_st_warm=_fr_set(f.fr_st_warm, d, f.st_warm, mi),
         fr_ret_pc=_fr_set(f.fr_ret_pc, d, old_pc, mi),
         fr_sp=_fr_set(f.fr_sp, d, f.sp - sin, mi),
         fr_sp_base=_fr_set(f.fr_sp_base, d, f.sp_base, mi),
@@ -573,6 +674,10 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
         fr_st_written=_fr_set(f.fr_st_written, d, f.st_written, mi),
         fr_st_acct=_fr_set(f.fr_st_acct, d, f.st_acct, mi),
         fr_acct_bal=_fr_set(f.fr_acct_bal, d, pre_transfer_bal, mi),
+        # ordinary call frame — not constructing an account (a stale slot
+        # from a popped CREATE frame at this depth must not leak in)
+        fr_create_slot=_fr_set(f.fr_create_slot, d,
+                               jnp.full((f.n_lanes,), -1, dtype=I32), mi),
     )
 
     # callee calldata: bytes from the caller's memory window
@@ -620,7 +725,8 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
 
     f2 = f2.replace(
         pc=jnp.where(mi, 0, f2.pc),
-        pc_hold=f2.pc_hold | mi,
+        # enum lanes stay parked on this CALL (one candidate per superstep)
+        pc_hold=f2.pc_hold | mi | enum_hold,
         sp=jnp.where(mi | m_push, f.sp - sin + m_push.astype(I32), f2.sp),
         sp_base=jnp.where(mi, f.sp - sin, f2.sp_base),
         depth=jnp.where(mi, f.depth + 1, f2.depth),
@@ -725,6 +831,7 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
     conc = pre & ~sym_in
     m_sha = conc & (pid == 2)
     m_id = conc & (pid == 4)
+    m_ecr = conc & (pid == 1)
 
     # modexp header: three 32-byte big-endian lengths
     blen = u256.to_u64_saturating(ci._be_bytes_to_word(inp[:, 0:32])).astype(I64)
@@ -736,7 +843,39 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
             & (mlen >= 0) & (mlen <= 32)
             & (96 + blen + elen + mlen <= a_len))
     m_mod = conc & (pid == 5) & fits
-    m_leaf = pre & ~m_sha & ~m_id & ~m_mod
+    m_leaf = pre & ~m_sha & ~m_id & ~m_mod & ~m_ecr
+
+    # concrete ecrecover via host callback (VERDICT r3 weak #6; reference
+    # uses libsecp256k1 ⚠unv — here ops/secp256k1, pure Python, memoized).
+    # Invalid signatures return EMPTY output, exactly like the precompile.
+    def _host_ecr(inp_np, mask_np):
+        import numpy as np
+
+        from ..ops.secp256k1 import ecrecover_batch
+
+        res = np.zeros((inp_np.shape[0], 32), dtype=np.uint8)
+        ok = np.zeros(inp_np.shape[0], dtype=bool)
+        idx = np.where(mask_np)[0]
+        for i, addr in zip(idx, ecrecover_batch(inp_np[idx, :128])):
+            if addr is not None:
+                res[i] = np.frombuffer(addr.to_bytes(32, "big"), np.uint8)
+                ok[i] = True
+        return res, ok
+
+    def _run_ecr(_):
+        return jax.pure_callback(
+            _host_ecr,
+            (jax.ShapeDtypeStruct((P, 32), jnp.uint8),
+             jax.ShapeDtypeStruct((P,), jnp.bool_)),
+            inp, m_ecr,
+        )
+
+    ecr_bytes, ecr_ok = lax.cond(
+        jnp.any(m_ecr), _run_ecr,
+        lambda _: (jnp.zeros((P, 32), dtype=jnp.uint8),
+                   jnp.zeros((P,), dtype=jnp.bool_)),
+        0,
+    )
 
     from ..ops.sha256 import sha256_device
     sha_w = lax.cond(
@@ -754,6 +893,21 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
         lambda: jnp.zeros((P, 8), dtype=U32),
     )
 
+    # precompile gas (reference: natives.py per-native schedules ⚠unv);
+    # modexp charges the EIP-2565 floor and pairing its base — the full
+    # input-dependent formulas are not modeled (documented)
+    words = (a_len + 31) // 32
+    pcost = jnp.select(
+        [pid == 1, pid == 2, pid == 3, pid == 4, pid == 5,
+         pid == 6, pid == 7, pid == 8],
+        [3000, 60 + 12 * words, 600 + 120 * words, 15 + 3 * words,
+         jnp.full_like(words, 200), jnp.full_like(words, 150),
+         jnp.full_like(words, 6000), jnp.full_like(words, 45000)],
+        default=jnp.zeros_like(words),
+    )
+    f = ci._charge(f, pre, pcost)
+    sf = sf.replace(base=f)
+
     # leaf result node (hash-consed per call site via the call index)
     kind = jnp.where(pid == 1, int(FreeKind.ECRECOVER), int(FreeKind.PRECOMPILE))
     sf, leaf = append_node(sf, m_leaf, int(SymOp.FREE), kind,
@@ -765,6 +919,7 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
                         jnp.where(pid == 5, mlen,
                                   jnp.where((pid == 6) | (pid == 7) | (pid == 9),
                                             64, 32))).astype(I64)
+    out_len = jnp.where(m_ecr, jnp.where(ecr_ok, 32, 0), out_len)
     out = jnp.where(m_id[:, None], inp[:, :RD] if INW >= RD else
                     jnp.pad(inp, ((0, 0), (0, RD - INW))), 0).astype(jnp.uint8)
     sha_bytes = ci._word_to_be_bytes(sha_w)  # u8[P,32]
@@ -779,9 +934,11 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
     out = jnp.where((m_sha[:, None] & head),
                     jnp.pad(sha_bytes, ((0, 0), (0, max(0, RD - 32)))), out)
     out = jnp.where(m_mod[:, None] & (kk < mlen[:, None]), mod_bytes, out)
+    out = jnp.where((m_ecr & ecr_ok)[:, None] & head,
+                    jnp.pad(ecr_bytes, ((0, 0), (0, max(0, RD - 32)))), out)
 
     # returndata buffer + memory window write
-    conc_res = m_sha | m_id | m_mod
+    conc_res = m_sha | m_id | m_mod | m_ecr
     n_out = jnp.clip(out_len, 0, RD).astype(I32)
     returndata = jnp.where(pre[:, None], out, f.returndata)
     returndata = jnp.where(
@@ -818,18 +975,47 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
     )
 
 
-def _h_sym_create(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
-    """CREATE/CREATE2: value transfer + a FRESH codeless account.
+def _init_jumpdest_scan(code, length):
+    """Jumpdest map of a per-lane code buffer u8[P, IC]: a byte is a valid
+    JUMPDEST iff it is 0x5B and not inside a PUSH immediate. Sequential
+    push-width skip via fori_loop (runs only under the CREATE cond)."""
+    P, IC = code.shape
 
-    The init code is not executed in-frame (documented over-approximation:
-    the created account's code is unknown to the engine, so later calls to
-    it take the external-havoc path — never a wrong value). Top-level
-    creation TRANSACTIONS are fully modeled by the analysis wrapper
-    (``SymExecWrapper`` creation mode; reference: ``create_`` spawning a
-    ContractCreationTransaction ⚠unv). The pushed result is a
-    deterministic fresh address per (lane, create index) — concrete and
-    unaliased with corpus accounts (CREATE2's keccak address identity is
-    not modeled; the address is fresh either way).
+    def body(i, carry):
+        skip, jd = carry
+        b = code[:, i].astype(I32)
+        live = i < length
+        is_jd = (skip == 0) & (b == 0x5B) & live
+        jd = jd.at[:, i].set(is_jd)
+        push_w = jnp.where((skip == 0) & (b >= 0x60) & (b <= 0x7F),
+                           b - 0x5F, 0)
+        skip = jnp.maximum(skip - 1, 0) + push_w
+        return skip, jd
+
+    _, jd = lax.fori_loop(
+        0, IC, body, (jnp.zeros(P, dtype=I32), jnp.zeros((P, IC), dtype=bool))
+    )
+    return jd
+
+
+def _h_sym_create(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
+    """CREATE/CREATE2: run the init code in a real sub-frame.
+
+    Reference: ``create_`` spawning a ContractCreationTransaction
+    (``mythril/laser/ethereum/instructions.py`` + ``transaction/`` ⚠unv,
+    SURVEY.md §2 "Transaction models"). A lane whose init window is
+    concrete (bytes, offset, length, value — and salt for CREATE2) pushes
+    a frame that EXECUTES the init code from a per-lane buffer: storage
+    writes land on the fresh account (``cur_acct`` = the new slot),
+    RETURN's payload is the deployed runtime image (matched against the
+    corpus at pop — see ``pop_frames``), REVERT rolls back storage,
+    balance and the account registration. CREATE2 addresses use the real
+    keccak identity (0xff ++ deployer ++ salt ++ keccak(init)); plain
+    CREATE addresses are deterministic fresh values (RLP-nonce addressing
+    not modeled). Fallback (symbolic window/value/salt, init too long,
+    nested constructor, no table/frame headroom): the round-3 behavior —
+    register a fresh CODE_UNKNOWN account, push its address, skip the
+    constructor (documented over-approximation).
     """
     f = sf.base
     P = f.n_lanes
@@ -838,10 +1024,14 @@ def _h_sym_create(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
     f = sf.base
     m = m & ~static_viol
     sin = ci._J_STACK_IN[op]
+    is_c2 = op == 0xF5
     value = ci._peek(f, 0)
     value_sym = _peek_sym(sf, 0)
     off = u256.to_u64_saturating(ci._peek(f, 1)).astype(I64)
     ln = u256.to_u64_saturating(ci._peek(f, 2)).astype(I64)
+    off_s, ln_s = _peek_sym(sf, 1), _peek_sym(sf, 2)
+    salt = jnp.where(is_c2[:, None], ci._peek(f, 3), 0).astype(U32)
+    salt_sym = jnp.where(is_c2, _peek_sym(sf, 3), 0)
     f, _ = ci._expand_memory(f, m & (ln > 0), off + ln)
     sf = sf.replace(base=f)
     sf = _record_call_event(sf, m, op, old_pc, jnp.zeros_like(value).astype(U32),
@@ -879,26 +1069,161 @@ def _h_sym_create(sf: SymFrontier, op, m, old_pc) -> SymFrontier:
     acct_bal = acct_bal.at[lanes, pay_idx].set(
         u256.sub(payer_bal, value), mode="drop")
 
+    # --- frame-execution eligibility (VERDICT r3 ask #2): registered,
+    # concrete window whose bytes carry no symbolic overlay, init fits the
+    # buffer, frame + no nested constructor, concrete salt
+    IC = f.init_code.shape[1]
+    D = f.fr_ret_pc.shape[1]
+    W = sf.mem_sym.shape[1]
+    wids = jnp.arange(W)[None, :]
+    win_sym = (sf.mem_havoc | jnp.any(
+        (wids >= (off // 32)[:, None])
+        & (wids < ((off + ln + 31) // 32)[:, None])
+        & (sf.mem_sym != 0), axis=1
+    )) & (ln > 0)
+    want_frame = (
+        reg & (off_s == 0) & (ln_s == 0) & (salt_sym == 0) & ~win_sym
+        & (ln > 0) & (ln <= IC) & (f.depth < D) & (f.init_depth == 0)
+    )
+
     dest_slot = f.sp - sin
+    m_push = m & ~want_frame  # frame lanes get their result at pop_frames
     res_w = jnp.where(ok[:, None], addr_w, 0).astype(U32)
-    stack = ci._set_slot(f.stack, dest_slot, res_w, m)
-    return sf.replace(
+    stack = ci._set_slot(f.stack, dest_slot, res_w, m_push)
+    sf = sf.replace(
         base=f.replace(
             stack=stack,
-            sp=jnp.where(m, f.sp - sin + 1, f.sp),
-            returndata_len=jnp.where(m, 0, f.returndata_len),
+            sp=jnp.where(m_push, f.sp - sin + 1, f.sp),
+            returndata_len=jnp.where(m_push, 0, f.returndata_len),
             acct_addr=acct_addr, acct_bal=acct_bal,
             acct_code=acct_code, acct_used=acct_used,
         ),
         stack_sym=_set_sym_slot(sf.stack_sym, dest_slot,
-                                jnp.zeros((P,), I32), m),
-        retdata_sym=jnp.where(m, False, sf.retdata_sym),
+                                jnp.zeros((P,), I32), m_push),
+        retdata_sym=jnp.where(m_push, False, sf.retdata_sym),
         create_cnt=sf.create_cnt + m.astype(I32),
         bal_epoch=sf.bal_epoch + (reg & wants).astype(I32),
     )
+    return lax.cond(
+        jnp.any(want_frame),
+        lambda s: _push_create_frame(s, want_frame, is_c2, slot, sin, off, ln,
+                                     salt, value, old_pc,
+                                     pre_transfer_bal=f.acct_bal),
+        lambda s: s,
+        sf,
+    )
 
 
-def pop_frames(sf: SymFrontier) -> SymFrontier:
+def _push_create_frame(sf: SymFrontier, mi, is_c2, slot, sin, off, ln, salt,
+                       value, old_pc, pre_transfer_bal) -> SymFrontier:
+    """Push the constructor frame for ``mi`` lanes (under the CREATE cond).
+
+    The child executes the init bytes copied from the caller's memory
+    (``exec_init`` fetch override), with ``cur_acct`` = the new account
+    slot so SSTOREs persist on the child, empty calldata, and the
+    endowment as callvalue. CREATE2 lanes overwrite the registered fresh
+    address with the real keccak identity."""
+    f = sf.base
+    P, M = f.memory.shape
+    IC = f.init_code.shape[1]
+    d = f.depth
+    lanes = jnp.arange(P)
+
+    init_code = ci._gather_bytes(f.memory, off, IC, jnp.full_like(off, M))
+    init_code = jnp.where(jnp.arange(IC)[None, :] < ln[:, None], init_code, 0)
+    init_code = jnp.where(mi[:, None], init_code, f.init_code).astype(jnp.uint8)
+    init_jd = jnp.where(mi[:, None], _init_jumpdest_scan(init_code, ln.astype(I32)),
+                        f.init_jd)
+
+    # CREATE2: addr = keccak(0xff ++ deployer[20] ++ salt[32] ++ keccak(init))[12:]
+    from ..ops.keccak import keccak256_device
+    inner = keccak256_device(init_code, jnp.clip(ln, 0, IC).astype(I32))
+    self_be = ci._word_to_be_bytes(f.self_address)      # u8[P,32]
+    salt_be = ci._word_to_be_bytes(salt)
+    inner_be = ci._word_to_be_bytes(inner)
+    buf = jnp.concatenate(
+        [jnp.full((P, 1), 0xFF, dtype=jnp.uint8), self_be[:, 12:32],
+         salt_be, inner_be], axis=1)                     # u8[P,85]
+    c2_addr = keccak256_device(buf, jnp.full(P, 85, dtype=I32))
+    c2_addr = c2_addr.at[:, 5:].set(0)                   # low 160 bits
+    do_c2 = mi & is_c2
+    aidx = jnp.where(do_c2, slot, f.acct_used.shape[1])
+    acct_addr = f.acct_addr.at[lanes, aidx].set(c2_addr, mode="drop")
+
+    # CREATE forwards all-but-one-64th (EIP-150; no gas operand)
+    remaining = jnp.maximum(f.gas_limit - f.gas_max, 0)
+    fwd = remaining - remaining // 64
+    f2 = f.replace(
+        acct_addr=acct_addr,
+        fr_gas_limit=_fr_set(f.fr_gas_limit, d, f.gas_limit, mi),
+        gas_limit=jnp.where(mi, f.gas_max + fwd, f.gas_limit),
+        fr_warm_acct=_fr_set(f.fr_warm_acct, d, f.warm_acct, mi),
+        fr_st_warm=_fr_set(f.fr_st_warm, d, f.st_warm, mi),
+        fr_ret_pc=_fr_set(f.fr_ret_pc, d, old_pc, mi),
+        fr_sp=_fr_set(f.fr_sp, d, f.sp - sin, mi),
+        fr_sp_base=_fr_set(f.fr_sp_base, d, f.sp_base, mi),
+        fr_static=_fr_set(f.fr_static, d, f.static, mi),
+        fr_cur_acct=_fr_set(f.fr_cur_acct, d, f.cur_acct, mi),
+        fr_contract_id=_fr_set(f.fr_contract_id, d, f.contract_id, mi),
+        fr_caller_addr=_fr_set(f.fr_caller_addr, d, f.caller_addr, mi),
+        fr_callvalue=_fr_set(f.fr_callvalue, d, f.callvalue, mi),
+        fr_memory=_fr_set(f.fr_memory, d, f.memory, mi),
+        fr_mem_words=_fr_set(f.fr_mem_words, d, f.mem_words, mi),
+        fr_calldata=_fr_set(f.fr_calldata, d, f.calldata, mi),
+        fr_calldata_len=_fr_set(f.fr_calldata_len, d, f.calldata_len, mi),
+        fr_ret_off=_fr_set(f.fr_ret_off, d, jnp.zeros_like(off), mi),
+        fr_ret_len=_fr_set(f.fr_ret_len, d, jnp.zeros_like(ln), mi),
+        fr_gas_min=_fr_set(f.fr_gas_min, d, f.gas_min, mi),
+        fr_gas_max=_fr_set(f.fr_gas_max, d, f.gas_max, mi),
+        fr_st_keys=_fr_set(f.fr_st_keys, d, f.st_keys, mi),
+        fr_st_vals=_fr_set(f.fr_st_vals, d, f.st_vals, mi),
+        fr_st_used=_fr_set(f.fr_st_used, d, f.st_used, mi),
+        fr_st_written=_fr_set(f.fr_st_written, d, f.st_written, mi),
+        fr_st_acct=_fr_set(f.fr_st_acct, d, f.st_acct, mi),
+        fr_acct_bal=_fr_set(f.fr_acct_bal, d, pre_transfer_bal, mi),
+        fr_create_slot=_fr_set(f.fr_create_slot, d, slot, mi),
+    )
+    f2 = f2.replace(
+        pc=jnp.where(mi, 0, f2.pc),
+        pc_hold=f2.pc_hold | mi,
+        sp=jnp.where(mi, f.sp - sin, f2.sp),
+        sp_base=jnp.where(mi, f.sp - sin, f2.sp_base),
+        depth=jnp.where(mi, f.depth + 1, f2.depth),
+        cur_acct=jnp.where(mi, slot, f2.cur_acct),
+        caller_addr=jnp.where(mi[:, None], f.self_address, f2.caller_addr),
+        callvalue=jnp.where(mi[:, None], value, f2.callvalue).astype(U32),
+        memory=jnp.where(mi[:, None], 0, f2.memory),
+        mem_words=jnp.where(mi, 0, f2.mem_words),
+        calldata=jnp.where(mi[:, None], 0, f2.calldata),
+        calldata_len=jnp.where(mi, 0, f2.calldata_len),
+        returndata_len=jnp.where(mi, 0, f2.returndata_len),
+        init_code=init_code,
+        init_len=jnp.where(mi, ln.astype(I32), f.init_len),
+        init_jd=init_jd,
+        init_depth=jnp.where(mi, f.depth + 1, f.init_depth),
+    )
+    return sf.replace(
+        base=f2,
+        mem_sym=jnp.where(mi[:, None], 0, sf.mem_sym),
+        mem_havoc=jnp.where(mi, False, sf.mem_havoc),
+        cd_from_mem=sf.cd_from_mem | mi,
+        cd_havoc=jnp.where(mi, False, sf.cd_havoc),
+        cd_sym=jnp.where(mi[:, None], 0, sf.cd_sym),
+        callvalue_sym=jnp.where(mi, 0, sf.callvalue_sym),
+        caller_sym=jnp.where(mi, 0, sf.caller_sym),
+        fr_caller_sym=_fr_set(sf.fr_caller_sym, d, sf.caller_sym, mi),
+        fr_mem_sym=_fr_set(sf.fr_mem_sym, d, sf.mem_sym, mi),
+        fr_mem_havoc=_fr_set(sf.fr_mem_havoc, d, sf.mem_havoc, mi),
+        fr_cd_from_mem=_fr_set(sf.fr_cd_from_mem, d, sf.cd_from_mem, mi),
+        fr_cd_havoc=_fr_set(sf.fr_cd_havoc, d, sf.cd_havoc, mi),
+        fr_cd_sym=_fr_set(sf.fr_cd_sym, d, sf.cd_sym, mi),
+        fr_callvalue_sym=_fr_set(sf.fr_callvalue_sym, d, sf.callvalue_sym, mi),
+        fr_st_val_sym=_fr_set(sf.fr_st_val_sym, d, sf.st_val_sym, mi),
+        fr_st_key_sym=_fr_set(sf.fr_st_key_sym, d, sf.st_key_sym, mi),
+    )
+
+
+def pop_frames(sf: SymFrontier, corpus: Corpus) -> SymFrontier:
     """Return control to the caller for every lane whose sub-frame ended.
 
     Reference: ``TransactionEndSignal`` handling in ``LaserEVM.exec`` —
@@ -917,6 +1242,9 @@ def pop_frames(sf: SymFrontier) -> SymFrontier:
     success = mp & f.halted & ~f.reverted & ~f.error
     fail = mp & (f.error | f.reverted)
     d = jnp.maximum(f.depth - 1, 0)
+    # constructor frames: fr_create_slot >= 0 marks the account being built
+    cslot = _fr_get(f.fr_create_slot, d)
+    is_initp = mp & (cslot >= 0)
 
     ret_pc = _fr_get(f.fr_ret_pc, d)
     csp = _fr_get(f.fr_sp, d)
@@ -975,20 +1303,91 @@ def pop_frames(sf: SymFrontier) -> SymFrontier:
     acct_bal = roll(f.acct_bal, _fr_get(f.fr_acct_bal, d))
     st_val_sym = roll(sf.st_val_sym, _fr_get(sf.fr_st_val_sym, d))
     st_key_sym = roll(sf.st_key_sym, _fr_get(sf.fr_st_key_sym, d))
-    gas_min = jnp.where(fail, _fr_get(f.fr_gas_min, d), f.gas_min)
-    gas_max = jnp.where(fail, _fr_get(f.fr_gas_max, d), f.gas_max)
+    # warm sets roll back with the frame (EIP-2929: a reverted call's
+    # access-list growth is undone)
+    warm_acct = roll(f.warm_acct, _fr_get(f.fr_warm_acct, d))
+    st_warm = roll(f.st_warm, _fr_get(f.fr_st_warm, d))
+    # gas: an EXCEPTIONAL halt burns the entire forwarded allowance
+    # (child_limit - caller gas at push); a REVERT returns the unused
+    # remainder, so the child's accumulated totals stand
+    fwd = f.gas_limit - _fr_get(f.fr_gas_max, d)
+    fail_exc = mp & f.error
+    gas_min = jnp.where(fail_exc, _fr_get(f.fr_gas_min, d) + fwd, f.gas_min)
+    gas_max = jnp.where(fail_exc, _fr_get(f.fr_gas_max, d) + fwd, f.gas_max)
+    gas_limit = jnp.where(mp, _fr_get(f.fr_gas_limit, d), f.gas_limit)
 
-    # success flag pushed at the caller's post-args sp
+    # success flag pushed at the caller's post-args sp; a constructor frame
+    # pushes the CHILD ADDRESS instead (0 on failure) — the EVM result of
+    # CREATE/CREATE2 is an address, not a boolean
     one_w = jnp.zeros((P, 8), dtype=U32).at[:, 0].set(1)
-    res_w = jnp.where(success[:, None], one_w, 0).astype(U32)
+    child_addr = f.acct_field(f.acct_addr, jnp.maximum(cslot, 0))
+    res_w = jnp.where(
+        success[:, None],
+        jnp.where(is_initp[:, None], child_addr, one_w),
+        0,
+    ).astype(U32)
     stack = ci._set_slot(f.stack, csp, res_w, mp)
     stack_sym = _set_sym_slot(sf.stack_sym, csp, jnp.zeros((P,), I32), mp)
+
+    # constructor epilogue: the RETURN payload is the deployed runtime
+    # image. Concretely match it against the corpus (factories deploying
+    # known children become callable); empty code -> EOA-like; unmatched
+    # -> CODE_UNKNOWN stays. A failed constructor unregisters the account
+    # (its storage/balance rolled back with the frame snapshots; accounts
+    # a NESTED create registered are not rolled back — documented).
+    lanes_p = jnp.arange(P)
+    acct_used_p = f.acct_used.at[
+        lanes_p, jnp.where(is_initp & fail, jnp.maximum(cslot, 0),
+                           f.acct_used.shape[1])
+    ].set(False, mode="drop")
+
+    def _resolve_child_code(acct_code_in):
+        # the deployed image is concrete bytes in `retval`: byte-compare it
+        # against every corpus image (both are zero-padded past their
+        # lengths, so whole-window equality + length equality suffices).
+        # A match makes the child CALLABLE (factory-deploys-known-child);
+        # empty code -> EOA-like; no match / image beyond the retval cap
+        # -> CODE_UNKNOWN (calls to it havoc, never wrong)
+        rl = f.retval_len
+        RD = f.retval.shape[1]
+        MC = corpus.code.shape[1]
+        Wn = min(RD, MC)
+        eq = (
+            jnp.all(f.retval[:, None, :Wn] == corpus.code[None, :, :Wn],
+                    axis=2)
+            & (rl[:, None] == corpus.code_len[None, :])
+            & (corpus.code_len[None, :] <= RD)
+            & (corpus.code_len[None, :] > 0)
+        )
+        # a symbolic byte anywhere in the returned image makes the concrete
+        # compare meaningless — such a deploy stays CODE_UNKNOWN
+        hit = jnp.any(eq, axis=1) & ~rv_unknown
+        resolved = jnp.where(
+            hit, jnp.argmax(eq, axis=1).astype(I32),
+            jnp.where((rl == 0) & ~rv_unknown, -1, CODE_UNKNOWN),
+        )
+        cidx = jnp.where(is_initp & success, jnp.maximum(cslot, 0),
+                         f.acct_used.shape[1])
+        return acct_code_in.at[lanes_p, cidx].set(resolved, mode="drop")
+
+    acct_code_p = lax.cond(jnp.any(is_initp & success), _resolve_child_code,
+                           lambda ac: ac, f.acct_code)
+
+    # a successful CREATE leaves EMPTY returndata in the caller (EVM rule:
+    # only a reverting create exposes its revert payload)
+    has_rd = has_rd & ~(is_initp & success)
 
     base = f.replace(
         pc=jnp.where(mp, ret_pc + 1, f.pc),
         sp=jnp.where(mp, csp + 1, f.sp),
         sp_base=jnp.where(mp, _fr_get(f.fr_sp_base, d), f.sp_base),
         depth=jnp.where(mp, d, f.depth),
+        init_depth=jnp.where(is_initp, 0, f.init_depth),
+        acct_used=acct_used_p,
+        acct_code=acct_code_p,
+        fr_create_slot=f.fr_create_slot.at[
+            lanes_p, jnp.where(is_initp, d, f.fr_create_slot.shape[1])
+        ].set(-1, mode="drop"),
         static=jnp.where(mp, _fr_get(f.fr_static, d), f.static),
         cur_acct=jnp.where(mp, _fr_get(f.fr_cur_acct, d), f.cur_acct),
         contract_id=jnp.where(mp, _fr_get(f.fr_contract_id, d), f.contract_id),
@@ -1005,7 +1404,8 @@ def pop_frames(sf: SymFrontier) -> SymFrontier:
         stack=stack,
         st_keys=st_keys, st_vals=st_vals, st_used=st_used,
         st_written=st_written, st_acct=st_acct, acct_bal=acct_bal,
-        gas_min=gas_min, gas_max=gas_max,
+        warm_acct=warm_acct, st_warm=st_warm,
+        gas_min=gas_min, gas_max=gas_max, gas_limit=gas_limit,
         halted=f.halted & ~mp,
         reverted=f.reverted & ~mp,
         error=f.error & ~mp,
@@ -1501,17 +1901,120 @@ def _overlay(sf: SymFrontier, env: Env, spec: SymSpec, op, m, cls, pre_sp,
 # ---------------------------------------------------------------------------
 
 
+def _berlin_gas_pre(sf: SymFrontier, op, run, a, s) -> SymFrontier:
+    """EIP-2929 cold surcharges, charged to the EXECUTING frame before
+    dispatch (so a sub-call's rollback snapshot includes its caller's
+    access cost — access-list growth is never refunded... except by frame
+    revert, which the fr_warm_* snapshots handle).
+
+    Warm/cold resolution: storage keys against the associative cache's
+    per-tx ``st_warm`` bits; addresses against the account table's
+    ``warm_acct``. A SYMBOLIC key/address — and any address outside the
+    table — cannot be tracked: the surcharge lands in ``gas_max`` only
+    (``gas_min`` keeps the all-warm floor), preserving min <= actual <=
+    max. Account-op targets are marked warm here; storage marking happens
+    post-dispatch (``_berlin_gas_post``) once SSTORE has allocated."""
+    from ..disassembler.opcodes import (G_COLD_ACCOUNT, G_COLD_SLOAD,
+                                        G_WARM_ACCESS)
+
+    f = sf.base
+    P = f.n_lanes
+    lanes = jnp.arange(P)
+    # the static berlin table already charged the WARM base; the cold
+    # surcharge is the DIFFERENCE (EVM: cold replaces, not augments)
+    SUR_SLOAD = G_COLD_SLOAD - G_WARM_ACCESS
+    SUR_ACCT = G_COLD_ACCOUNT - G_WARM_ACCESS
+
+    # --- storage: SLOAD/SSTORE (key = operand 0)
+    m_st = run & ((op == 0x54) | (op == 0x55))
+    key_conc = s[0] == 0
+    hit, _, slot = ci._storage_lookup(f, a[0])
+    K = f.st_warm.shape[1]
+    warm_bit = jnp.take_along_axis(
+        f.st_warm, jnp.clip(slot, 0, K - 1)[:, None], axis=1)[:, 0]
+    st_cold = ~(hit & warm_bit)
+    st_sur_max = jnp.where(m_st, SUR_SLOAD, 0).astype(I64)
+    st_sur_min = jnp.where(m_st & key_conc & st_cold, SUR_SLOAD, 0).astype(I64)
+    st_sur_max = jnp.where(m_st & key_conc & ~st_cold, 0, st_sur_max)
+
+    # --- account access: BALANCE/EXTCODESIZE/EXTCODECOPY/EXTCODEHASH
+    # (addr = operand 0), CALL family (operand 1), SELFDESTRUCT (operand 0)
+    m_acct0 = run & ((op == 0x31) | (op == 0x3B) | (op == 0x3C)
+                     | (op == 0x3F) | (op == 0xFF))
+    m_call = run & (ci._J_CLASS[op] == ci.CLS_CALL)
+    addr_w = jnp.where(m_call[:, None], a[1], a[0])
+    addr_sym = jnp.where(m_call, s[1], s[0])
+    m_addr = (m_acct0 | m_call)
+    found, aslot = f.acct_lookup(addr_w)
+    A = f.warm_acct.shape[1]
+    awarm = found & jnp.take_along_axis(
+        f.warm_acct, jnp.clip(aslot, 0, A - 1)[:, None], axis=1)[:, 0]
+    addr_conc = addr_sym == 0
+    tracked = addr_conc & found
+    # a SYMBOLIC CALL target is not charged here: the callee-enumeration
+    # fork that resolves it re-executes with a concrete target and pays
+    # then (charging the parked lane once per retry would compound); the
+    # never-resolving external fallback pays in _h_sym_call. The other
+    # address ops (BALANCE/EXTCODE*/SELFDESTRUCT) execute exactly once,
+    # so a symbolic address charges cold into gas_max right now.
+    ac_sur_min = jnp.where(m_addr & tracked & ~awarm, SUR_ACCT, 0).astype(I64)
+    ac_sur_max = jnp.where(
+        (m_addr & addr_conc & (~found | ~awarm))
+        | (m_acct0 & ~addr_conc), SUR_ACCT, 0).astype(I64)
+
+    # mark touched table accounts warm (symbolic addresses can't resolve)
+    aidx = jnp.where(m_addr & tracked, aslot, A)
+    warm_acct = f.warm_acct.at[lanes, aidx].set(True, mode="drop")
+
+    return sf.replace(base=f.replace(
+        gas_min=f.gas_min + st_sur_min + ac_sur_min,
+        gas_max=f.gas_max + st_sur_max + ac_sur_max,
+        warm_acct=warm_acct,
+    ))
+
+
+def _berlin_gas_post(sf: SymFrontier, op, run, key_w, key_s) -> SymFrontier:
+    """Post-dispatch storage warm marking: the touched key's cache entry
+    (allocated by SSTORE, the symbolic SLOAD memo, or here for a concrete
+    SLOAD miss) gets its per-tx warm bit."""
+    f = sf.base
+    P = f.n_lanes
+    lanes = jnp.arange(P)
+    m_st = run & ((op == 0x54) | (op == 0x55)) & (key_s == 0) & ~f.error
+    hit, _, slot = ci._storage_lookup(f, key_w)
+    # concrete SLOAD miss: allocate a (key, 0, unwritten) entry so the
+    # NEXT access is provably warm (the concrete handler doesn't insert)
+    need_alloc = m_st & ~hit & (op == 0x54)
+    widx, overflow = ci.storage_alloc(f, hit, slot, need_alloc)
+    st_keys = f.st_keys.at[lanes, widx].set(key_w, mode="drop")
+    st_used = f.st_used.at[lanes, widx].set(True, mode="drop")
+    st_acct = f.st_acct.at[lanes, widx].set(f.cur_acct, mode="drop")
+    # a full cache simply loses warm tracking (overcharges later, sound)
+    K = f.st_warm.shape[1]
+    midx = jnp.where(m_st & hit, slot,
+                     jnp.where(need_alloc & ~overflow, widx, K))
+    st_warm = f.st_warm.at[lanes, jnp.clip(midx, 0, K)].set(
+        True, mode="drop")
+    return sf.replace(base=f.replace(
+        st_keys=st_keys, st_used=st_used, st_acct=st_acct, st_warm=st_warm,
+    ))
+
+
 def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
                   spec: SymSpec = SymSpec(),
                   limits: LimitsConfig = DEFAULT_LIMITS) -> SymFrontier:
     """Advance every running lane by one instruction, symbolically."""
-    f, op, run, old_pc = ci.prologue(sf.base, corpus)
+    berlin = limits.gas_schedule == "berlin"
+    f, op, run, old_pc = ci.prologue(sf.base, corpus, berlin=berlin)
     sf = sf.replace(base=f)
     cls = ci._J_CLASS[op]
     pre_sp = f.sp
     pre_stack_sym = sf.stack_sym
     a = [ci._peek(f, i) for i in range(4)]
     s = [_peek_sym(sf, i) for i in range(7)]
+    if berlin:
+        sf = _berlin_gas_pre(sf, op, run, a, s)
+        f = sf.base
 
     is_jumpi = op == 0x57
     known, ksign = _lookup_constraint(sf, s[1])
@@ -1557,19 +2060,23 @@ def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
                      lambda x: _h_sym_claimed_misc(x, op, claim_memoff, claim_sha3off,
                                                    claim_copyoff, claim_haltoff, claim_logoff))
 
+    if berlin:
+        sf = _berlin_gas_post(sf, op, run, a[0], s[0])
+
     # bounded loops: any jump that landed at-or-before its own pc (the
     # fork-taken copies are counted in expand_forks)
     fb = sf.base
     back = (run & (cls == ci.CLS_JUMP) & ~fb.halted & ~fb.error
             & (fb.pc <= old_pc))
-    sf = _note_backjump(sf, back, fb.pc, limits.loop_bound)
+    sf = _note_backjump(sf, back, old_pc, fb.pc, limits.loop_bound)
 
     f = ci.epilogue(sf.base, op, run, old_pc)
     sf = sf.replace(base=f)
     # sub-frames that halted (or failed) this step return to their caller
     any_ended = jnp.any(sf.base.active & (sf.base.depth > 0)
                         & (sf.base.halted | sf.base.error))
-    return lax.cond(any_ended, pop_frames, lambda x: x, sf)
+    return lax.cond(any_ended, lambda x: pop_frames(x, corpus),
+                    lambda x: x, sf)
 
 
 def between_txs(sf: SymFrontier, require_mutation: bool = True,
@@ -1651,6 +2158,17 @@ def between_txs(sf: SymFrontier, require_mutation: bool = True,
             log_topic0=jnp.where(go[:, None, None], 0, b.log_topic0),
             log_data0=jnp.where(go[:, None, None], 0, b.log_data0),
             st_written=jnp.where(go[:, None], False, b.st_written),
+            init_depth=jnp.where(go, 0, b.init_depth),
+            init_len=jnp.where(go, 0, b.init_len),
+            # EIP-2929 access lists are per-transaction: reset to the
+            # tx-start warm set (origin/caller + the target account)
+            warm_acct=jnp.where(
+                go[:, None],
+                (jnp.arange(b.warm_acct.shape[1])[None, :] == ACCT_ATTACKER)
+                | (jnp.arange(b.warm_acct.shape[1])[None, :]
+                   == b.home_acct[:, None]),
+                b.warm_acct),
+            st_warm=jnp.where(go[:, None], False, b.st_warm),
         ),
         stack_sym=jnp.where(go[:, None], 0, sf.stack_sym),
         mem_sym=jnp.where(go[:, None], 0, sf.mem_sym),
@@ -1697,6 +2215,8 @@ def between_txs(sf: SymFrontier, require_mutation: bool = True,
         arb_key_pc=jnp.where(go, -1, sf.arb_key_pc),
         arb_key_cid=jnp.where(go, 0, sf.arb_key_cid),
         dropped_forks=jnp.zeros_like(sf.dropped_forks),
+        call_enum=jnp.zeros_like(sf.call_enum),
+        fork_cslot=jnp.full_like(sf.fork_cslot, -1),
         n_arith=jnp.zeros_like(sf.n_arith),
         arith_op=jnp.where(go[:, None], 0, sf.arith_op),
         arith_a=jnp.where(go[:, None], 0, sf.arith_a),
@@ -1721,13 +2241,25 @@ def between_txs(sf: SymFrontier, require_mutation: bool = True,
 
 def expand_forks(sf: SymFrontier, loop_bound: int = 0,
                  fork_block: int = 0,
-                 fork_policy: str = "fifo") -> SymFrontier:
+                 fork_policy: str = "fifo",
+                 defer_starved: bool = False,
+                 visited=None) -> SymFrontier:
     """Materialize fork requests: copy each forking lane into a free lane
     (prefix-sum compaction), point the copy at the jump target, and flip
     its final path-condition sign to "taken". Forks beyond capacity are
     counted in ``dropped_forks`` (the frontier equivalent of the
     reference's unbounded ``work_list.append`` ⚠unv). A copy whose taken
     target is a BACKWARD jump feeds the bounded-loops policy.
+
+    ``defer_starved=True`` (SURVEY §5.7 spill machinery, VERDICT r3 ask
+    #3) turns the drop channel into a RETRY: a request with no free lane
+    un-executes its branch decision — pc back on the JUMPI (or still
+    parked on the CALL), operand pops and the appended constraint undone
+    — and the lane re-raises the identical request next superstep, when
+    retiring lanes may have freed slots. ``fork_req`` stays set on parked
+    lanes so the host seam can see persistent starvation and rebalance
+    them into other blocks' free lanes (``rebalance_parked``); nothing is
+    lost inside a chunk.
 
     ``fork_block`` makes the compaction SHARD-LOCAL (VERDICT r2 ask #5):
     with the lane axis sharded over devices, a global cumsum/sort would
@@ -1755,7 +2287,11 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
     G = P // B
     loc = jnp.arange(B, dtype=I32)[None, :]
     gidx = jnp.broadcast_to(jnp.arange(G, dtype=I32)[:, None], (G, B))
-    req2 = sf.fork_req.reshape(G, B)
+    # a lane the feasibility sweep killed between its request and this
+    # expansion must NOT be copied back to life (its con_len was already
+    # unwound, so the sign-flip would land on an unrelated constraint)
+    req_live = sf.fork_req & sf.base.active
+    req2 = req_live.reshape(G, B)
     free2 = (~sf.base.active).reshape(G, B)
     n_free = jnp.sum(free2.astype(I32), axis=1, keepdims=True)
     if fork_policy == "fifo":
@@ -1763,14 +2299,48 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
     else:
         depth = sf.con_len.reshape(G, B)
         C = sf.con_node.shape[1]
-        key = depth if fork_policy == "shallow" else (C - depth)
-        key = jnp.where(req2, key, C + 1)  # non-requesting lanes sort last
+        if fork_policy in ("shallow", "beam"):
+            key = depth
+        elif fork_policy == "deep":
+            key = C - depth
+        elif fork_policy == "weighted":
+            # weighted-random admission (reference: the weighted-random
+            # strategy's 2^-depth bias ⚠unv, SURVEY §1 row 7): a cheap
+            # per-(lane, target, depth) hash scaled by path depth —
+            # shallow paths usually win, but a lucky deep fork can jump
+            # the queue. Deterministic (counter-free) by design so runs
+            # replay exactly.
+            h = (jnp.arange(P, dtype=jnp.uint32) * jnp.uint32(2654435761)
+                 + sf.fork_dest.astype(jnp.uint32) * jnp.uint32(40503)
+                 + sf.con_len.astype(jnp.uint32) * jnp.uint32(131))
+            h = ((h >> 16) ^ h).astype(I32) & 1023
+            key = (h.reshape(G, B) * (depth + 1)) % 65536
+        elif fork_policy == "coverage":
+            # coverage-guided: forks whose taken target has NOT been
+            # visited admit first (reference: coverage_strategy wrapper
+            # ⚠unv); ties resolve by lane order (stable sort)
+            if visited is None:
+                key = jnp.zeros((G, B), dtype=I32)
+            else:
+                MC = visited.shape[1]
+                seen = visited[
+                    jnp.clip(sf.base.contract_id, 0, visited.shape[0] - 1),
+                    jnp.clip(sf.fork_dest, 0, MC - 1)]
+                key = seen.astype(I32).reshape(G, B)
+        else:
+            raise ValueError(f"unknown fork_policy: {fork_policy}")
+        key = jnp.where(req2, key, 1 << 20)  # non-requesting lanes sort last
         order = jnp.argsort(key, axis=1, stable=True).astype(I32)
         rank = jnp.zeros((G, B), dtype=I32).at[gidx, order].set(
             jnp.broadcast_to(loc, (G, B)))
     free_ids = jnp.sort(jnp.where(free2, loc, B), axis=1)
+    # beam: admit at most B//4 forks per block per superstep (shallowest
+    # first via the key above) — the frontier analog of a beam width
+    # (reference: beam.py ⚠unv); the rest defer/drop by mode
+    n_adm = (jnp.minimum(n_free, max(1, B // 4))
+             if fork_policy == "beam" else n_free)
     slot2 = jnp.where(
-        req2 & (rank < n_free),
+        req2 & (rank < n_adm),
         jnp.take_along_axis(free_ids, jnp.clip(rank, 0, B - 1), axis=1),
         B,
     )  # local free-slot index per forking lane; B = dropped
@@ -1780,7 +2350,7 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
         True, mode="drop").reshape(P)
     slot = jnp.where(slot2 < B, slot2 + jnp.arange(G, dtype=I32)[:, None] * B,
                      P).reshape(P)
-    req = sf.fork_req
+    req = req_live
 
     # scalar run-total counters pass through untouched (ndim == 0); they
     # must not be gathered over the lane axis. The gather itself runs
@@ -1798,27 +2368,128 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
     last = (jnp.arange(C)[None, :] == (new.con_len - 1)[:, None]) & is_copy[:, None]
     # fork copies must not inherit the source lane's loss counter — that
     # would double-count every prior drop once per fork
-    n_dropped = (req & (slot == P)).astype(I32)
+    starved = req & (slot == P)
+    n_dropped = jnp.zeros(P, I32) if defer_starved else starved.astype(I32)
     dropped = jnp.where(is_copy, 0, new.dropped_forks) + n_dropped
     # the source lane sits at (JUMPI pc)+1 after the superstep, so a taken
     # target strictly below the copied pc is a backward jump
     back_copy = is_copy & (new.fork_dest < b.pc)
+    # symbolic-callee forks: the copy re-executes the CALL with the target
+    # stack slot concretized to the candidate address (its flipped EQ
+    # constraint asserts to == addr, so the concrete write is faithful)
+    cs = new.fork_cslot
+    S = b.stack.shape[1]
+    cidx = jnp.where(is_copy & (cs >= 0) & (cs < S), cs, S).astype(I32)
+    lanes_p = jnp.arange(P)
+    stack_c = b.stack.at[lanes_p, cidx].set(new.fork_cval, mode="drop")
+    stack_sym_c = new.stack_sym.at[lanes_p, cidx].set(0, mode="drop")
+
+    is_cf = cs >= 0  # call-enumeration fork (source parked on the CALL)
+    if defer_starved:
+        # un-execute the branch decision so the lane retries next superstep:
+        # JUMPI sources step back onto the branch and re-push its operands;
+        # CALL sources (already parked) rewind the candidate counter; both
+        # pop the constraint the handler appended this superstep
+        pc_new = jnp.where(is_copy, new.fork_dest,
+                           jnp.where(starved & ~is_cf, b.pc - 1, b.pc))
+        sp_new = jnp.where(starved & ~is_cf, b.sp + 2, b.sp)
+        con_len_new = new.con_len - starved.astype(I32)
+        # the retried JUMPI re-pays its static charge next superstep
+        # (10 = G_HIGH; schedule-independent); CALL retries refund inside
+        # the call handler itself
+        g_undo = jnp.where(starved & ~is_cf, 10, 0).astype(b.gas_min.dtype)
+        b = b.replace(gas_min=b.gas_min - g_undo, gas_max=b.gas_max - g_undo)
+        call_enum_new = jnp.where(
+            is_copy, 0, new.call_enum - (starved & is_cf).astype(I32))
+        fork_req_new = starved
+    else:
+        pc_new = jnp.where(is_copy, new.fork_dest, b.pc)
+        sp_new = b.sp
+        con_len_new = new.con_len
+        call_enum_new = jnp.where(is_copy, 0, new.call_enum)
+        fork_req_new = jnp.zeros_like(new.fork_req)
     new = new.replace(
         base=b.replace(
-            pc=jnp.where(is_copy, new.fork_dest, b.pc),
+            pc=pc_new,
+            sp=sp_new,
             active=b.active | is_copy,
+            stack=stack_c,
         ),
+        stack_sym=stack_sym_c,
         con_sign=jnp.where(last, True, new.con_sign),
-        fork_req=jnp.zeros_like(new.fork_req),
+        con_len=con_len_new,
+        fork_req=fork_req_new,
+        fork_cslot=jnp.full_like(new.fork_cslot, -1),
+        fork_cval=jnp.zeros_like(new.fork_cval),
+        # a concretized copy is no longer enumerating; its next symbolic
+        # call site (if any) must scan the table from slot 0
+        call_enum=call_enum_new,
         dropped_forks=dropped,
         dropped_total=new.dropped_total + jnp.sum(n_dropped, dtype=I32),
     )
-    return _note_backjump(new, back_copy, new.fork_dest, loop_bound)
+    return _note_backjump(new, back_copy, b.pc - 1, new.fork_dest, loop_bound)
+
+
+def rebalance_parked(sf: SymFrontier, fork_block: int = 0):
+    """Move persistently starved fork-requesting lanes into other blocks'
+    free slots. Host-planned at the chunk seam, device-applied as one
+    gather/scatter per leaf — the jitted superstep loop stays shard-local
+    (SURVEY §5.7 spill-to-host overflow + §5.8 cross-device rebalancing:
+    only the scheduler boundary communicates).
+
+    A lane parked on a starved fork (``fork_req`` still set after
+    ``expand_forks`` with ``defer_starved``) whose own block has no free
+    slot is RELOCATED to the block with the most free slots (needs >= 2:
+    one for the lane, one for the fork it will re-raise); its old slot
+    frees up for its neighbors. Returns ``(sf, n_moved)``."""
+    import numpy as np
+
+    parked = np.asarray(sf.fork_req) & np.asarray(sf.base.active)
+    if not parked.any():
+        return sf, 0
+    P = parked.shape[0]
+    B = fork_block if fork_block > 0 else P
+    G = P // B
+    free = ~np.asarray(sf.base.active)
+    free_cnt = free.reshape(G, B).sum(axis=1)
+    free_lists = [list(np.where(free.reshape(G, B)[g])[0] + g * B)
+                  for g in range(G)]
+    src_idx, dst_idx = [], []
+    for lane in np.where(parked)[0]:
+        g = lane // B
+        if free_cnt[g] > 0:
+            continue  # the local retry will succeed on its own
+        g2 = int(np.argmax(free_cnt))
+        if free_cnt[g2] < 2:
+            continue  # no global headroom for (lane + its fork)
+        dst = free_lists[g2].pop()
+        free_cnt[g2] -= 1
+        src_idx.append(int(lane))
+        dst_idx.append(int(dst))
+        # the vacated slot serves the source block's remaining requests
+        free_cnt[g] += 1
+        free_lists[g].append(int(lane))
+    if not src_idx:
+        return sf, 0
+    src = jnp.asarray(src_idx, dtype=I32)
+    dst = jnp.asarray(dst_idx, dtype=I32)
+
+    def move(x):
+        if not hasattr(x, "ndim") or x.ndim == 0:
+            return x
+        return x.at[dst].set(x[src])
+
+    new = jax.tree.map(move, sf)
+    return new.replace(
+        base=new.base.replace(active=new.base.active.at[src].set(False)),
+        fork_req=new.fork_req.at[src].set(False),
+    ), len(src_idx)
 
 
 @functools.partial(
     jax.jit, static_argnames=("spec", "limits", "max_steps", "propagate_every",
-                              "fork_block", "track_coverage", "fork_policy")
+                              "fork_block", "track_coverage", "fork_policy",
+                              "defer_starved")
 )
 def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
             spec: SymSpec = SymSpec(),
@@ -1827,7 +2498,8 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
             propagate_every=None,
             fork_block: int = 0,
             track_coverage: bool = False,
-            fork_policy: str = "fifo"):
+            fork_policy: str = "fifo",
+            defer_starved: bool = False):
     """Run the symbolic engine until quiescence or max_steps supersteps.
     ``propagate_every`` > 0 interleaves feasibility sweeps that kill
     provably-unsat lanes (reference: lazy ``Solver.check()`` pruning);
@@ -1852,12 +2524,16 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
     def body(state):
         i, s, visited = state
         if track_coverage:
-            run = s.base.running
+            # init-frame pcs index the per-lane init buffer, not the
+            # contract image — they must not pollute its bitmap
+            run = s.base.running & ~s.base.exec_init
             cid = jnp.where(run, s.base.contract_id, C)
             pc = jnp.clip(s.base.pc, 0, MC - 1)
             visited = visited.at[cid, pc].set(True, mode="drop")
         s = sym_superstep(s, env, corpus, spec, limits)
-        s = expand_forks(s, limits.loop_bound, fork_block, fork_policy)
+        s = expand_forks(s, limits.loop_bound, fork_block, fork_policy,
+                         defer_starved,
+                         visited if track_coverage else None)
         if propagate_every:
             s = lax.cond(
                 (i % propagate_every) == propagate_every - 1,

@@ -356,6 +356,9 @@ def kill_infeasible(sf: SymFrontier) -> SymFrontier:
     inf = inf & sf.base.active & ~sf.base.error
     return sf.replace(
         base=sf.base.replace(active=sf.base.active & ~inf),
+        # a killed lane's pending (deferred) fork request dies with it —
+        # expand_forks also guards, but the invariant belongs here
+        fork_req=sf.fork_req & ~inf,
         killed_infeasible=sf.killed_infeasible | inf,
         killed_total=sf.killed_total + jnp.sum(inf, dtype=jnp.int32),
     )

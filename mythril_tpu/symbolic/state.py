@@ -116,7 +116,7 @@ class SymFrontier:
     killed_total: jnp.ndarray  # i32[] run total of propagation kills (survives
     # lane recycling — per-lane flags are lost when expand_forks reuses a slot)
     # --- bounded-loops policy (reference: BoundedLoopsStrategy ⚠unv) ---
-    lb_key: jnp.ndarray      # i32[P, LBS] back-jump target keys (cid*32768+pc)
+    lb_key: jnp.ndarray      # i64[P, LBS] back-jump keys ((cid, src, dest) packed)
     lb_cnt: jnp.ndarray      # i32[P, LBS] taken-count per target
     lb_len: jnp.ndarray      # i32[P]
     # --- dependency pruner (reference: DependencyPruner ⚠unv) ---
@@ -126,6 +126,12 @@ class SymFrontier:
     fork_dest: jnp.ndarray   # i32[P] jump target of the taken branch
     dropped_forks: jnp.ndarray  # i32[P] forks lost to capacity (reported)
     dropped_total: jnp.ndarray  # i32[] run total of dropped forks
+    # symbolic-callee enumeration (CALL with symbolic target forks one
+    # candidate account per superstep; the fork copy re-executes the CALL
+    # with the target stack slot concretized — see _h_sym_call)
+    call_enum: jnp.ndarray   # i32[P] next candidate account slot to try
+    fork_cslot: jnp.ndarray  # i32[P] stack slot the fork copy concretizes (-1 = none)
+    fork_cval: jnp.ndarray   # u32[P, 8] concrete value for that slot
     # --- detection-facing event records ---
     # every pc-bearing event also records the EXECUTING contract id at
     # record time (``*_cid``): a pc recorded inside a callee frame must not
@@ -263,12 +269,15 @@ def make_sym_frontier(
         con_len=z(P),
         killed_infeasible=jnp.zeros(P, dtype=bool),
         killed_total=jnp.zeros((), dtype=I32),
-        lb_key=jnp.full((P, L.loop_slots), -1, dtype=I32),
+        lb_key=jnp.full((P, L.loop_slots), -1, dtype=jnp.int64),
         lb_cnt=z(P, L.loop_slots),
         lb_len=z(P),
         dep_read=jnp.zeros(P, dtype=bool),
         fork_req=jnp.zeros(P, dtype=bool),
         fork_dest=z(P),
+        call_enum=z(P),
+        fork_cslot=jnp.full(P, -1, dtype=I32),
+        fork_cval=jnp.zeros((P, 8), dtype=U32),
         dropped_forks=z(P),
         dropped_total=jnp.zeros((), dtype=I32),
         sym_jump_dest=z(P),
