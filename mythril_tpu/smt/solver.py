@@ -37,37 +37,47 @@ class SolverStatistics:
 
     attempts: int = 0
     sat: int = 0
+    unsat: int = 0
     unknown: int = 0
+    cache_hits: int = 0
     time_sec: float = 0.0
 
-    def record(self, found: bool, dt: float) -> None:
+    def record(self, verdict: str, dt: float, cached: bool = False) -> None:
         self.attempts += 1
-        if found:
+        if verdict == "sat":
             self.sat += 1
+        elif verdict == "unsat":
+            self.unsat += 1
         else:
             self.unknown += 1
+        if cached:
+            self.cache_hits += 1
         self.time_sec += dt
 
     def reset(self) -> None:
-        self.attempts = self.sat = self.unknown = 0
+        self.attempts = self.sat = self.unsat = self.unknown = 0
+        self.cache_hits = 0
         self.time_sec = 0.0
 
     def snapshot(self) -> "SolverStatistics":
-        return SolverStatistics(self.attempts, self.sat, self.unknown,
-                                self.time_sec)
+        return SolverStatistics(self.attempts, self.sat, self.unsat,
+                                self.unknown, self.cache_hits, self.time_sec)
 
     def delta(self, since: "SolverStatistics") -> dict:
         return {
             "attempts": self.attempts - since.attempts,
             "sat": self.sat - since.sat,
+            "unsat": self.unsat - since.unsat,
             "unknown": self.unknown - since.unknown,
+            "cache_hits": self.cache_hits - since.cache_hits,
             "time_sec": round(self.time_sec - since.time_sec, 3),
         }
 
     def as_dict(self) -> dict:
         return {
-            "attempts": self.attempts, "sat": self.sat,
-            "unknown": self.unknown, "time_sec": round(self.time_sec, 3),
+            "attempts": self.attempts, "sat": self.sat, "unsat": self.unsat,
+            "unknown": self.unknown, "cache_hits": self.cache_hits,
+            "time_sec": round(self.time_sec, 3),
         }
 
 
@@ -237,13 +247,62 @@ def _mutate_leaf(tape: HostTape, leaf: int, asn: Assignment, rng: random.Random)
     _assign_leaf(leaf, nd, v, asn)
 
 
+#: memoized solve front door (reference: ``support/model.py get_model``'s
+#: lru cache ⚠unv, SURVEY §2 "Model cache"). Key = full structural
+#: fingerprint + search budget; capped FIFO so corpus runs can't grow it
+#: unboundedly. Caching `unknown` is safe because the budget is in the key.
+_SOLVE_CACHE: Dict[tuple, Tuple[str, Optional[Assignment]]] = {}
+_SOLVE_CACHE_CAP = 8192
+
+
+def _fingerprint(tape: HostTape, seed: int, max_iters: int) -> tuple:
+    return (
+        tuple((nd.op, nd.a, nd.b, nd.imm) for nd in tape.nodes),
+        tuple((int(n), bool(s)) for n, s in tape.constraints),
+        seed, max_iters,
+    )
+
+
+def solve_tape_ex(tape: HostTape, seed: int = 0, max_iters: int = 400,
+                  base: Optional[Assignment] = None
+                  ) -> Tuple[str, Optional[Assignment]]:
+    """(verdict, assignment) with verdict in {"sat", "unsat", "unknown"}.
+
+    Three-verdict pipeline (VERDICT r3 ask #4): the memo cache first, then
+    a structural refutation pass (proven UNSAT is recorded distinctly from
+    search-exhausted UNKNOWN in ``SOLVER_STATS``), then the witness
+    search. ``base``-seeded queries skip the cache (the assignment is an
+    input the fingerprint does not cover)."""
+    from .refute import refute_tape
+
+    t0 = time.perf_counter()
+    key = None
+    if base is None:
+        key = _fingerprint(tape, seed, max_iters)
+        hit = _SOLVE_CACHE.get(key)
+        if hit is not None:
+            verdict, asn = hit
+            SOLVER_STATS.record(verdict, time.perf_counter() - t0,
+                                cached=True)
+            return verdict, (asn.copy() if asn is not None else None)
+
+    if refute_tape(tape) is not None:
+        verdict, out = "unsat", None
+    else:
+        out = _solve_tape_inner(tape, seed, max_iters, base)
+        verdict = "sat" if out is not None else "unknown"
+    if key is not None:
+        if len(_SOLVE_CACHE) >= _SOLVE_CACHE_CAP:
+            _SOLVE_CACHE.pop(next(iter(_SOLVE_CACHE)))
+        _SOLVE_CACHE[key] = (verdict, out.copy() if out is not None else None)
+    SOLVER_STATS.record(verdict, time.perf_counter() - t0)
+    return verdict, out
+
+
 def solve_tape(tape: HostTape, seed: int = 0, max_iters: int = 400,
                base: Optional[Assignment] = None) -> Optional[Assignment]:
     """Find an assignment satisfying every tape constraint, or None."""
-    t0 = time.perf_counter()
-    out = _solve_tape_inner(tape, seed, max_iters, base)
-    SOLVER_STATS.record(out is not None, time.perf_counter() - t0)
-    return out
+    return solve_tape_ex(tape, seed, max_iters, base)[1]
 
 
 def _solve_tape_inner(tape: HostTape, seed: int = 0, max_iters: int = 400,
@@ -309,8 +368,9 @@ class Solver:
         self.tape.constraints.append((node, sign))
 
     def check(self) -> str:
-        self._model = solve_tape(self.tape, self.seed, self.max_iters)
-        return "sat" if self._model is not None else "unknown"
+        verdict, self._model = solve_tape_ex(self.tape, self.seed,
+                                             self.max_iters)
+        return verdict
 
     def model(self) -> Assignment:
         if self._model is None:

@@ -101,7 +101,9 @@ def test_unsat_contradiction_returns_none():
     node, sign = tape.constraints[-1]
     s = Solver(tape, max_iters=50)
     s.add(node, not sign)
-    assert s.check() == "unknown"
+    # round 4: the refutation pass PROVES this contradiction instead of
+    # burning search budget and degrading to unknown (VERDICT r3 ask #4)
+    assert s.check() == "unsat"
 
 
 def test_solver_front_door_sat_and_model():
@@ -115,3 +117,94 @@ def test_solver_front_door_sat_and_model():
     assert s.check() == "sat"
     m = s.model()
     assert bytes(m.calldata[:4]) == bytes.fromhex("a9059cbb")
+
+
+# --- round-4 unsat verdicts + model cache (VERDICT r3 ask #4) ---
+
+def _mk_tape(nodes, constraints):
+    from mythril_tpu.smt.tape import HostTape
+    return HostTape(nodes=nodes, constraints=constraints)
+
+
+def _nodes_eq_two_values():
+    from mythril_tpu.smt.tape import HostNode
+    from mythril_tpu.symbolic.ops import SymOp, FreeKind
+    N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)
+    return [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),   # 1: leaf
+        N(SymOp.CONST, imm=5),                            # 2
+        N(SymOp.CONST, imm=7),                            # 3
+        N(SymOp.EQ, 1, 2),                                # 4: leaf == 5
+        N(SymOp.EQ, 1, 3),                                # 5: leaf == 7
+    ]
+
+
+def test_refute_forced_value_conflict():
+    from mythril_tpu.smt.refute import refute_tape
+
+    t = _mk_tape(_nodes_eq_two_values(), [(4, True), (5, True)])
+    assert refute_tape(t) is not None, "leaf==5 AND leaf==7 must refute"
+    # sat variants must NOT refute
+    assert refute_tape(_mk_tape(_nodes_eq_two_values(),
+                                [(4, True), (5, False)])) is None
+
+
+def test_refute_through_injective_chain():
+    from mythril_tpu.smt.tape import HostNode
+    from mythril_tpu.smt.refute import refute_tape
+    from mythril_tpu.symbolic.ops import SymOp, FreeKind
+    N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)
+    # ADD(leaf, 10) == 15  (forces leaf == 5)  AND  leaf == 6
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),   # 1
+        N(SymOp.CONST, imm=10),                           # 2
+        N(SymOp.ADD, 1, 2),                               # 3
+        N(SymOp.CONST, imm=15),                           # 4
+        N(SymOp.EQ, 3, 4),                                # 5
+        N(SymOp.CONST, imm=6),                            # 6
+        N(SymOp.EQ, 1, 6),                                # 7
+    ]
+    assert refute_tape(_mk_tape(nodes, [(5, True), (7, True)])) is not None
+    assert refute_tape(_mk_tape(nodes, [(5, True), (7, False)])) is None
+
+
+def test_refute_interval_conflict():
+    from mythril_tpu.smt.tape import HostNode
+    from mythril_tpu.smt.refute import refute_tape
+    from mythril_tpu.symbolic.ops import SymOp, FreeKind
+    N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)
+    # leaf < 5 AND leaf > 10
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),   # 1
+        N(SymOp.CONST, imm=5),                            # 2
+        N(SymOp.CONST, imm=10),                           # 3
+        N(SymOp.LT, 1, 2),                                # 4: leaf < 5
+        N(SymOp.GT, 1, 3),                                # 5: leaf > 10
+    ]
+    assert refute_tape(_mk_tape(nodes, [(4, True), (5, True)])) is not None
+    assert refute_tape(_mk_tape(nodes, [(4, True), (5, False)])) is None
+
+
+def test_solve_tape_memo_cache():
+    from mythril_tpu.smt.solver import (SOLVER_STATS, _SOLVE_CACHE,
+                                        solve_tape)
+
+    t = _mk_tape(_nodes_eq_two_values(), [(4, True)])
+    _SOLVE_CACHE.clear()
+    before = SOLVER_STATS.snapshot()
+    a1 = solve_tape(t)
+    a2 = solve_tape(t)
+    d = SOLVER_STATS.delta(before)
+    assert a1 is not None and a2 is not None
+    assert d["cache_hits"] == 1, d
+    assert d["sat"] == 2, d
+    # unsat verdicts are recorded distinctly and cached too
+    tu = _mk_tape(_nodes_eq_two_values(), [(4, True), (5, True)])
+    before = SOLVER_STATS.snapshot()
+    assert solve_tape(tu) is None
+    assert solve_tape(tu) is None
+    d = SOLVER_STATS.delta(before)
+    assert d["unsat"] == 2 and d["cache_hits"] == 1, d
