@@ -60,9 +60,15 @@ def create_parser() -> argparse.ArgumentParser:
                    help="witness-search repair iterations per query")
     a.add_argument("--execution-timeout", type=float, default=None,
                    help="wall-clock budget in seconds for the exploration")
-    a.add_argument("--strategy", choices=["bfs", "dfs"], default="bfs",
+    a.add_argument("--strategy",
+                   choices=["bfs", "dfs", "weighted-random", "coverage",
+                            "beam"],
+                   default="bfs",
                    help="fork-admission policy when frontier slots run "
-                        "short (the frontier itself steps breadth-first)")
+                        "short (the frontier itself steps breadth-first): "
+                        "bfs=fifo, dfs=deepest-first, weighted-random="
+                        "depth-weighted hash, coverage=unvisited-target "
+                        "first, beam=capped shallowest-first")
     a.add_argument("--limits-profile", choices=["default", "test"],
                    default="default",
                    help="frontier shape caps: 'test' compiles a much "
@@ -74,8 +80,61 @@ def create_parser() -> argparse.ArgumentParser:
                    help="write the contract CFG as graphviz DOT, explored "
                         "blocks highlighted")
 
+    a.add_argument("--corpus", metavar="DIR",
+                   help="campaign mode: analyze every *.hex/*.bin under "
+                        "DIR in constant-shape batches (one compiled "
+                        "engine), with checkpoint/resume; prints a "
+                        "throughput+issues JSON")
+    a.add_argument("--batch-size", type=int, default=32,
+                   help="contracts per compiled batch (campaign mode)")
+    a.add_argument("--checkpoint-dir", metavar="DIR",
+                   help="campaign checkpoint directory (resume-able)")
+    a.add_argument("-a", "--address", metavar="ADDRESS",
+                   help="analyze the on-chain contract at ADDRESS "
+                        "(requires --rpc)")
+    a.add_argument("--rpc", metavar="URI",
+                   help="JSON-RPC endpoint; 'file:PATH' uses a JSON mock "
+                        "({addr: {code, storage}})")
+
     d = sub.add_parser("disassemble", aliases=["d"], help="print EASM")
     add_input_flags(d)
+
+    c = sub.add_parser("concolic",
+                       help="flip branches of a concrete trace "
+                            "(hybrid-fuzzing helper)")
+    add_input_flags(c)
+    c.add_argument("--calldata", required=True, metavar="HEX",
+                   help="seed transaction calldata")
+    c.add_argument("--callvalue", type=int, default=0)
+    c.add_argument("--jump-addresses", metavar="LIST",
+                   help="comma-separated JUMPI pcs to flip (default: all)")
+    c.add_argument("--max-steps", type=int, default=256)
+    c.add_argument("--solver-iters", type=int, default=400)
+    c.add_argument("--limits-profile", choices=["default", "test"],
+                   default="default")
+
+    rs = sub.add_parser("read-storage",
+                        help="read a live contract's storage slot over RPC")
+    rs.add_argument("index", help="storage slot (int or 0xhex)")
+    rs.add_argument("address", help="contract address")
+    rs.add_argument("--rpc", required=True, metavar="URI")
+
+    f2h = sub.add_parser("function-to-hash",
+                         help="4-byte selector of a function signature")
+    f2h.add_argument("signature", help='e.g. "transfer(address,uint256)"')
+
+    h2a = sub.add_parser("hash-to-address",
+                         help="EIP-55 address from a 32-byte storage word")
+    h2a.add_argument("hashes", nargs="+", help="32-byte hex words")
+
+    sf_ = sub.add_parser("safe-functions",
+                         help="functions with no issues found")
+    add_input_flags(sf_)
+    sf_.add_argument("-t", "--transaction-count", type=int, default=2)
+    sf_.add_argument("--max-steps", type=int, default=512)
+    sf_.add_argument("--lanes-per-contract", type=int, default=64)
+    sf_.add_argument("--limits-profile", choices=["default", "test"],
+                     default="default")
 
     sub.add_parser("list-detectors", help="list registered detection modules")
     sub.add_parser("version", help="print version")
@@ -85,6 +144,19 @@ def create_parser() -> argparse.ArgumentParser:
 def _load_contracts(args):
     from ..mythril import MythrilDisassembler
 
+    if getattr(args, "address", None):
+        if not getattr(args, "rpc", None):
+            print("error: -a/--address requires --rpc", file=sys.stderr)
+            raise SystemExit(2)
+        from ..utils.loader import DynLoader, rpc_client_from_uri
+
+        dl = DynLoader(rpc_client_from_uri(args.rpc))
+        code = dl.dynld(int(args.address, 16))
+        if not code:
+            print(f"error: no code at {args.address}", file=sys.stderr)
+            raise SystemExit(2)
+        return [MythrilDisassembler.load_from_bytecode(
+            code.hex(), name=args.address)]
     if getattr(args, "artifact", None):
         from ..solidity import get_contracts_from_standard_json
 
@@ -110,6 +182,8 @@ def exec_analyze(args) -> int:
     from ..mythril import MythrilAnalyzer, MythrilConfig
     from ..symbolic import SymSpec
 
+    if getattr(args, "corpus", None):
+        return _exec_campaign(args)
     contracts = _load_contracts(args)
     if args.code and args.creation_code:
         with open(args.creation_code) as fh:
@@ -146,6 +220,40 @@ def exec_analyze(args) -> int:
     return 0
 
 
+def _exec_campaign(args) -> int:
+    """Corpus campaign: BASELINE configs 2-3 (SURVEY §6)."""
+    import json
+
+    from ..config import DEFAULT_LIMITS, TEST_LIMITS
+    from ..mythril.campaign import CorpusCampaign, load_corpus_dir
+    from ..symbolic import SymSpec
+
+    contracts = load_corpus_dir(args.corpus)
+    campaign = CorpusCampaign(
+        contracts,
+        batch_size=args.batch_size,
+        lanes_per_contract=args.lanes_per_contract,
+        limits=TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS,
+        spec=SymSpec(storage=not args.concrete_storage),
+        max_steps=args.max_steps,
+        transaction_count=args.transaction_count,
+        modules=args.modules.split(",") if args.modules else None,
+        checkpoint_dir=args.checkpoint_dir,
+        execution_timeout=args.execution_timeout,
+    )
+
+    def progress(done, total, dt, n_issues):
+        print(f"batch {done}/{total}: {dt:.1f}s, {n_issues} issue(s) so far",
+              file=sys.stderr)
+
+    res = campaign.run(progress=progress)
+    out = res.as_dict()
+    if args.outform in ("json", "jsonv2"):
+        out["issues_detail"] = res.issues
+    print(json.dumps(out, indent=1))
+    return 0
+
+
 def _write_graph(path: str, contract, analyzer) -> None:
     """DOT CFG of the first contract, explored blocks highlighted."""
     from ..disassembler.cfg import CFG
@@ -167,6 +275,109 @@ def exec_disassemble(args) -> int:
     return 0
 
 
+def exec_concolic(args) -> int:
+    """Reference: ``myth concolic`` (``mythril/concolic`` ⚠unv) — here a
+    front door over :func:`concolic_execution` (one sym_run serves every
+    branch flip)."""
+    import json
+
+    from ..concolic import concolic_execution
+    from ..config import DEFAULT_LIMITS, TEST_LIMITS
+
+    contracts = _load_contracts(args)
+    ja = ([int(x, 0) for x in args.jump_addresses.split(",")]
+          if args.jump_addresses else None)
+    flips = concolic_execution(
+        contracts[0].code,
+        bytes.fromhex(args.calldata.removeprefix("0x")),
+        jump_addresses=ja,
+        callvalue=args.callvalue,
+        limits=TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS,
+        max_steps=args.max_steps,
+        solver_iters=args.solver_iters,
+    )
+    print(json.dumps([
+        {"pc": f.pc, "constraint_index": f.constraint_index,
+         "calldata": "0x" + f.calldata.hex(),
+         "callvalue": f.callvalue, "caller": f"0x{f.caller:040x}"}
+        for f in flips
+    ], indent=1))
+    return 0
+
+
+def exec_read_storage(args) -> int:
+    from ..utils.loader import DynLoader, rpc_client_from_uri
+
+    dl = DynLoader(rpc_client_from_uri(args.rpc))
+    word = dl.read_storage(int(args.address, 16), int(args.index, 0))
+    print(f"0x{word:064x}")
+    return 0
+
+
+def exec_function_to_hash(args) -> int:
+    from ..utils.signatures import selector_of
+
+    print("0x" + selector_of(args.signature))
+    return 0
+
+
+def _checksum_address(addr20: bytes) -> str:
+    """EIP-55 mixed-case checksum encoding."""
+    from ..ops.keccak import keccak256_host
+
+    hexaddr = addr20.hex()
+    h = keccak256_host(hexaddr.encode()).hex()
+    return "0x" + "".join(
+        ch.upper() if ch.isalpha() and int(h[i], 16) >= 8 else ch
+        for i, ch in enumerate(hexaddr)
+    )
+
+
+def exec_hash_to_address(args) -> int:
+    """Reference: ``myth hash-to-address`` — a 32-byte storage word whose
+    low 20 bytes are an address, rendered checksummed (⚠unv)."""
+    for word in args.hashes:
+        raw = bytes.fromhex(word.removeprefix("0x").rjust(64, "0"))
+        print(_checksum_address(raw[12:]))
+    return 0
+
+
+def exec_safe_functions(args) -> int:
+    """Reference: ``myth safe-functions`` — functions in which no issue
+    was detected (⚠unv). Coverage warnings are printed alongside: a
+    function is only as safe as the exploration was complete."""
+    from ..config import DEFAULT_LIMITS, TEST_LIMITS
+    from ..mythril import MythrilAnalyzer, MythrilConfig
+    from ..utils.signatures import SignatureDB
+
+    contracts = _load_contracts(args)
+    cfg = MythrilConfig(
+        limits=TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS,
+        transaction_count=args.transaction_count,
+        max_steps=args.max_steps,
+        lanes_per_contract=args.lanes_per_contract,
+    )
+    analyzer = MythrilAnalyzer(contracts, cfg)
+    report = analyzer.fire_lasers()
+    flagged = {i.function for i in report.issues if i.function}
+    db = SignatureDB()
+    for contract in contracts:
+        names = []
+        for sel in contract.disassembly.func_hashes:
+            sigs = db.lookup(sel)
+            # same fallback name _label_functions gives issues, so an
+            # unknown-selector function with findings is never "safe"
+            name = sigs[0] if sigs else "0x" + sel.removeprefix("0x")
+            if name not in flagged:
+                names.append(name)
+        print(f"{contract.name}: {len(names)} safe function(s)")
+        for n in sorted(names):
+            print(f"  {n}")
+    for w in report.coverage_warnings():
+        print(f"warning: {w}", file=sys.stderr)
+    return 0
+
+
 def exec_list_detectors(args) -> int:
     from ..analysis import ModuleLoader
 
@@ -182,6 +393,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         return exec_analyze(args)
     if args.command in ("disassemble", "d"):
         return exec_disassemble(args)
+    if args.command == "concolic":
+        return exec_concolic(args)
+    if args.command == "read-storage":
+        return exec_read_storage(args)
+    if args.command == "function-to-hash":
+        return exec_function_to_hash(args)
+    if args.command == "hash-to-address":
+        return exec_hash_to_address(args)
+    if args.command == "safe-functions":
+        return exec_safe_functions(args)
     if args.command == "list-detectors":
         return exec_list_detectors(args)
     if args.command == "version":

@@ -1,0 +1,208 @@
+"""Corpus-scale analysis campaign (BASELINE configs 2-3, VERDICT r3 ask #6).
+
+The north star is 10k contracts through the full SWC suite in minutes —
+nothing like the reference exists for this (users shell-script one
+``myth`` process per contract, SURVEY §2.3); the frontier engine instead
+streams fixed-shape BATCHES of contracts through ONE compiled program:
+
+- every batch has exactly ``batch_size`` contracts x ``lanes_per_contract``
+  lanes (short batches pad with a STOP stub), so XLA compiles once and
+  every subsequent batch replays the cached executable;
+- a JSON checkpoint (issues + batch cursor) lands after every batch;
+  resume skips completed batches — a killed 10k-contract run loses at
+  most one batch of work;
+- the campaign report carries the BASELINE metrics: contracts/sec,
+  paths/sec, issues, solver statistics, per-batch wall times.
+
+CLI: ``python -m mythril_tpu analyze --corpus DIR`` (see interfaces/cli).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..config import DEFAULT_LIMITS, LimitsConfig
+from ..symbolic import SymSpec
+
+#: pad contract for short batches: plain STOP (no paths beyond the seed,
+#: no issues, negligible lane cost)
+_PAD_BYTECODE = b"\x00"
+
+
+def load_corpus_dir(path: str) -> List[tuple]:
+    """(name, runtime bytecode) for every *.hex / *.bin / *.bin-runtime
+    file under ``path`` (hex-encoded, 0x prefix optional), sorted for a
+    stable batch order."""
+    from ..disassembler.disassembly import _to_bytes
+
+    out = []
+    for fn in sorted(os.listdir(path)):
+        if not fn.endswith((".hex", ".bin", ".bin-runtime")):
+            continue
+        with open(os.path.join(path, fn)) as fh:
+            text = fh.read().strip()
+        if not text:
+            continue
+        out.append((fn.rsplit(".", 1)[0], _to_bytes(text)))
+    if not out:
+        raise ValueError(f"no *.hex / *.bin corpus files under {path}")
+    return out
+
+
+@dataclass
+class CampaignResult:
+    contracts: int = 0
+    batches: int = 0
+    issues: List[Dict] = field(default_factory=list)
+    wall_sec: float = 0.0
+    compile_sec: float = 0.0   # first batch (compile-dominated)
+    paths_total: int = 0
+    dropped_forks: int = 0
+    solver: Dict = field(default_factory=dict)
+    batch_wall: List[float] = field(default_factory=list)
+
+    def as_dict(self) -> Dict:
+        # rates derive from the per-batch wall times, which the
+        # checkpoint persists — a resumed run must not divide an
+        # all-batches numerator by a one-session denominator
+        total = sum(self.batch_wall)
+        steady = self.batch_wall[1:] or self.batch_wall
+        per_batch = self.contracts / self.batches if self.batches else 0.0
+        steady_rate = (
+            round(per_batch * len(steady) / sum(steady), 3)
+            if steady and sum(steady) > 0 else 0.0
+        )
+        return {
+            "contracts": self.contracts,
+            "batches": self.batches,
+            "issues": len(self.issues),
+            "wall_sec": round(total, 3),
+            "wall_sec_this_session": round(self.wall_sec, 3),
+            "contracts_per_sec": round(
+                self.contracts / total, 3) if total else 0.0,
+            "contracts_per_sec_steady": steady_rate,
+            "paths_total": self.paths_total,
+            "paths_per_sec": round(
+                self.paths_total / total, 1) if total else 0.0,
+            "dropped_forks": self.dropped_forks,
+            "solver": self.solver,
+        }
+
+
+class CorpusCampaign:
+    """Stream a contract corpus through the analysis pipeline in
+    constant-shape batches with checkpoint/resume."""
+
+    def __init__(
+        self,
+        contracts: Sequence[tuple],            # (name, runtime bytecode)
+        batch_size: int = 32,
+        lanes_per_contract: int = 32,
+        limits: LimitsConfig = DEFAULT_LIMITS,
+        spec: SymSpec = SymSpec(),
+        max_steps: int = 256,
+        transaction_count: int = 1,
+        modules: Optional[Sequence[str]] = None,
+        checkpoint_dir: Optional[str] = None,
+        execution_timeout: Optional[float] = None,
+    ):
+        self.contracts = list(contracts)
+        self.batch_size = batch_size
+        self.lanes_per_contract = lanes_per_contract
+        self.limits = limits
+        self.spec = spec
+        self.max_steps = max_steps
+        self.transaction_count = transaction_count
+        self.modules = list(modules) if modules else None
+        self.checkpoint_dir = checkpoint_dir
+        self.execution_timeout = execution_timeout
+
+    # --- checkpointing -------------------------------------------------
+    @property
+    def _ckpt_path(self) -> Optional[str]:
+        if self.checkpoint_dir is None:
+            return None
+        return os.path.join(self.checkpoint_dir, "campaign.json")
+
+    def _load_ckpt(self) -> Dict:
+        p = self._ckpt_path
+        if p and os.path.exists(p):
+            with open(p) as fh:
+                return json.load(fh)
+        return {"next_batch": 0, "issues": [], "batch_wall": [],
+                "paths_total": 0, "dropped_forks": 0}
+
+    def _save_ckpt(self, state: Dict) -> None:
+        p = self._ckpt_path
+        if p is None:
+            return
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(state, fh)
+        os.replace(tmp, p)  # atomic: a crash never corrupts the cursor
+
+    # --- the campaign --------------------------------------------------
+    def run(self, progress=None) -> CampaignResult:
+        from ..analysis import SymExecWrapper, fire_lasers
+        from ..smt.solver import SOLVER_STATS
+
+        t_start = time.monotonic()
+        deadline = (None if self.execution_timeout is None
+                    else t_start + self.execution_timeout)
+        state = self._load_ckpt()
+        res = CampaignResult()
+        res.issues = list(state["issues"])
+        res.batch_wall = list(state["batch_wall"])
+        res.paths_total = int(state["paths_total"])
+        res.dropped_forks = int(state["dropped_forks"])
+        stats_at_start = SOLVER_STATS.snapshot()
+
+        n_batches = (len(self.contracts) + self.batch_size - 1) // self.batch_size
+        for bi in range(state["next_batch"], n_batches):
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            batch = self.contracts[bi * self.batch_size:(bi + 1) * self.batch_size]
+            names = [n for n, _ in batch]
+            codes = [c for _, c in batch]
+            # constant compiled shape: pad the tail batch with STOP stubs
+            while len(codes) < self.batch_size:
+                names.append(f"_pad_{len(codes)}")
+                codes.append(_PAD_BYTECODE)
+            t0 = time.monotonic()
+            sym = SymExecWrapper(
+                codes, contract_names=names, limits=self.limits,
+                spec=self.spec, lanes_per_contract=self.lanes_per_contract,
+                max_steps=self.max_steps,
+                transaction_count=self.transaction_count,
+            )
+            report = fire_lasers(sym, white_list=self.modules)
+            dt = time.monotonic() - t0
+            cov = sym.coverage
+            for issue in report.issues:
+                if issue.contract.startswith("_pad_"):
+                    continue
+                d = issue.as_dict()
+                d["batch"] = bi
+                res.issues.append(d)
+            res.batch_wall.append(dt)
+            res.paths_total += int(cov.get("surviving_paths", 0))
+            res.dropped_forks += int(cov.get("dropped_forks", 0))
+            state.update(next_batch=bi + 1, issues=res.issues,
+                         batch_wall=res.batch_wall,
+                         paths_total=res.paths_total,
+                         dropped_forks=res.dropped_forks)
+            self._save_ckpt(state)
+            if progress is not None:
+                progress(bi + 1, n_batches, dt, len(res.issues))
+
+        res.batches = len(res.batch_wall)
+        res.contracts = min(res.batches * self.batch_size, len(self.contracts))
+        res.wall_sec = time.monotonic() - t_start
+        res.compile_sec = res.batch_wall[0] if res.batch_wall else 0.0
+        res.solver = SOLVER_STATS.delta(stats_at_start)
+        return res

@@ -24,6 +24,67 @@ class DynLoaderError(RuntimeError):
     pass
 
 
+class FileRpcClient:
+    """Mock RPC backed by a JSON file:
+    ``{"0xaddr": {"code": "0x...", "storage": {"0x0": "0x..."}}}`` —
+    the same shape the reference's RPC tests mock (SURVEY.md §4)."""
+
+    def __init__(self, path: str):
+        import json
+
+        with open(path) as fh:
+            self._db = {k.lower(): v for k, v in json.load(fh).items()}
+
+    def eth_getCode(self, address: str) -> str:
+        return self._db.get(address.lower(), {}).get("code", "0x")
+
+    def eth_getStorageAt(self, address: str, slot: str) -> str:
+        st = self._db.get(address.lower(), {}).get("storage", {})
+        norm = {int(k, 16): v for k, v in st.items()}
+        return norm.get(int(slot, 16), "0x0")
+
+
+class HttpRpcClient:
+    """Minimal JSON-RPC-over-HTTP client (reference: ``EthJsonRpc``
+    ⚠unv). Functional code path; unreachable in this zero-egress image,
+    exercised through the same interface as :class:`FileRpcClient`."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url
+        self.timeout = timeout
+        self._id = 0
+
+    def _call(self, method: str, params):
+        import json
+        import urllib.request
+
+        self._id += 1
+        req = urllib.request.Request(
+            self.url,
+            data=json.dumps({"jsonrpc": "2.0", "id": self._id,
+                             "method": method, "params": params}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            body = json.load(resp)
+        if "error" in body:
+            raise DynLoaderError(f"rpc error: {body['error']}")
+        return body["result"]
+
+    def eth_getCode(self, address: str) -> str:
+        return self._call("eth_getCode", [address, "latest"])
+
+    def eth_getStorageAt(self, address: str, slot: str) -> str:
+        return self._call("eth_getStorageAt", [address, slot, "latest"])
+
+
+def rpc_client_from_uri(uri: str):
+    """``file:PATH`` -> mock client; anything http(s) -> JSON-RPC."""
+    if uri.startswith("file:"):
+        return FileRpcClient(uri[len("file:"):])
+    return HttpRpcClient(uri)
+
+
 class DynLoader:
     """Front door for on-chain lookups (reference: ``DynLoader.dynld`` /
     ``read_storage`` ⚠unv)."""

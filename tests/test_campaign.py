@@ -1,0 +1,73 @@
+"""Corpus campaign driver (VERDICT r3 ask #6, BASELINE configs 2-3):
+constant-shape batches, one compiled engine, checkpoint/resume."""
+
+import json
+
+import numpy as np
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.config import TEST_LIMITS
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.mythril.campaign import CorpusCampaign, load_corpus_dir
+
+KILLABLE = assemble(0, "SELFDESTRUCT")
+SAFE = assemble(1, 0, "SSTORE", "STOP")
+
+
+def write_corpus(tmp_path, n=6):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    for i in range(n):
+        code = KILLABLE if i % 2 == 0 else SAFE
+        (d / f"c{i:03d}.hex").write_text(code.hex())
+    return str(d)
+
+
+def make_campaign(corpus_dir, ckpt=None):
+    return CorpusCampaign(
+        load_corpus_dir(corpus_dir),
+        batch_size=4,               # 6 contracts -> 2 batches (tail padded)
+        lanes_per_contract=8,
+        limits=TEST_LIMITS,
+        max_steps=64,
+        transaction_count=1,
+        modules=["AccidentallyKillable"],
+        checkpoint_dir=ckpt,
+    )
+
+
+def test_campaign_batches_and_metrics(tmp_path):
+    corpus = write_corpus(tmp_path)
+    res = make_campaign(corpus).run()
+    assert res.batches == 2 and res.contracts == 6
+    d = res.as_dict()
+    assert d["contracts_per_sec"] > 0 and d["wall_sec"] > 0
+    assert "attempts" in d["solver"]
+    # 3 killable contracts, none from padding stubs
+    bad = {i["contract"] for i in res.issues}
+    assert bad == {"c000", "c002", "c004"}, bad
+    assert all(i["swc-id"] == "106" for i in res.issues)
+
+
+def test_campaign_checkpoint_resume(tmp_path):
+    corpus = write_corpus(tmp_path)
+    ck = str(tmp_path / "ck")
+    full = make_campaign(corpus, ckpt=ck).run()
+    assert full.batches == 2
+
+    # a finished checkpoint resumes to a no-op, results preserved
+    again = make_campaign(corpus, ckpt=ck).run()
+    assert again.batches == 2
+    assert len(again.issues) == len(full.issues)
+
+    # rewind the cursor to mid-corpus: exactly one batch re-runs
+    p = f"{ck}/campaign.json"
+    state = json.load(open(p))
+    state["next_batch"] = 1
+    state["issues"] = [i for i in state["issues"] if i["batch"] < 1]
+    state["batch_wall"] = state["batch_wall"][:1]
+    json.dump(state, open(p, "w"))
+    resumed = make_campaign(corpus, ckpt=ck).run()
+    assert resumed.batches == 2
+    assert ({i["contract"] for i in resumed.issues}
+            == {i["contract"] for i in full.issues})

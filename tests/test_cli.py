@@ -117,3 +117,84 @@ def test_analyze_jsonv2(capsys):
     assert issues and issues[0]["swcID"] == "SWC-106"
     assert "head" in issues[0]["description"]
     assert issues[0]["locations"][0]["sourceMap"].count(":") == 2
+
+
+# --- round-4 command completeness (VERDICT r3 ask #7) ---
+
+def test_function_to_hash(capsys):
+    rc, out = run_cli(capsys, "function-to-hash", "transfer(address,uint256)")
+    assert rc == 0 and out.strip() == "0xa9059cbb"
+
+
+def test_hash_to_address(capsys):
+    rc, out = run_cli(
+        capsys, "hash-to-address",
+        "0x0000000000000000000000005aaeb6053f3e94c9b9a09f33669435e7ef1beaed")
+    # EIP-55 reference vector
+    assert rc == 0
+    assert out.strip() == "0x5aAeb6053F3E94C9b9A09f33669435E7Ef1BeAed"
+
+
+def _write_rpc_mock(tmp_path, addr: str, code_hex: str, storage=None):
+    mock = {addr: {"code": "0x" + code_hex,
+                   "storage": {hex(k): hex(v)
+                               for k, v in (storage or {}).items()}}}
+    p = tmp_path / "rpc.json"
+    p.write_text(json.dumps(mock))
+    return f"file:{p}"
+
+
+def test_read_storage_via_mock_rpc(tmp_path, capsys):
+    uri = _write_rpc_mock(tmp_path, "0x" + "ab" * 20, "6001", {1: 0x2A})
+    rc, out = run_cli(capsys, "read-storage", "1", "0x" + "ab" * 20,
+                      "--rpc", uri)
+    assert rc == 0
+    assert int(out.strip(), 16) == 0x2A
+
+
+def test_analyze_address_via_mock_rpc(tmp_path, capsys):
+    uri = _write_rpc_mock(tmp_path, "0x" + "cd" * 20, KILLABLE)
+    rc, out = run_cli(capsys, "analyze", "-a", "0x" + "cd" * 20,
+                      "--rpc", uri, "-o", "json", "-t", "1",
+                      "--max-steps", "64", "--lanes-per-contract", "8",
+                      "--limits-profile", "test", "-m",
+                      "AccidentallyKillable")
+    assert rc == 0
+    issues = json.loads(out)["issues"]
+    assert any(i["swc-id"] == "106" for i in issues)
+
+
+def test_concolic_command(capsys):
+    # branch on calldata word: seed takes the fallthrough; the flip must
+    # produce calldata driving the taken side
+    code = assemble(
+        0, "CALLDATALOAD", ("ref", "set"), "JUMPI", "STOP",
+        ("label", "set"), 1, 0, "SSTORE", "STOP",
+    ).hex()
+    rc, out = run_cli(capsys, "concolic", "-c", code,
+                      "--calldata", "00" * 32,
+                      "--max-steps", "64", "--limits-profile", "test")
+    assert rc == 0
+    flips = json.loads(out)
+    assert len(flips) >= 1
+    assert any(int(f["calldata"][2:66] or "0", 16) != 0 for f in flips)
+
+
+def test_safe_functions(capsys):
+    # two-function dispatcher: kill() SELFDESTRUCTs (flagged),
+    # totalSupply() just stores (safe); both selectors are in the local
+    # signature DB
+    code = assemble(
+        0, "CALLDATALOAD", ("push1", 224), "SHR",
+        "DUP1", ("push4", 0x41C0E1B5), "EQ", ("ref", "kill"), "JUMPI",
+        "DUP1", ("push4", 0x18160DDD), "EQ", ("ref", "total"), "JUMPI",
+        "STOP",
+        ("label", "kill"), 0, "SELFDESTRUCT",
+        ("label", "total"), 1, 2, "SSTORE", "STOP",
+    ).hex()
+    rc, out = run_cli(capsys, "safe-functions", "-c", code,
+                      "-t", "1", "--max-steps", "64",
+                      "--lanes-per-contract", "8", "--limits-profile", "test")
+    assert rc == 0
+    assert "totalSupply()" in out, out
+    assert "kill()" not in out, out
