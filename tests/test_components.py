@@ -175,3 +175,28 @@ def test_fork_policies_agree_when_capacity_sufficient():
         assert np.array_equal(np.asarray(outs[p].base.active), base), (
             f"{p}: with free slots for every fork the policies must agree")
         assert int(np.asarray(outs[p].dropped_total)) == 0
+
+
+def test_jsonv2_carries_real_srcmap(tmp_path):
+    # VERDICT r3 weak #5: jsonv2 sourceMap must be the solc
+    # offset:length:fileIdx, not a synthesized pc:1:idx
+    from mythril_tpu.mythril import MythrilAnalyzer, MythrilConfig
+    from mythril_tpu.solidity.soliditycontract import SolidityContract
+
+    code = assemble(0, "SELFDESTRUCT")
+    src = "contract Kill {\n  function die() { selfdestruct(0); }\n}\n"
+    c = SolidityContract(
+        name="Kill", code=code,
+        srcmap=parse_srcmap("0:10:0;16:38:0"),
+        sources={0: ("Kill.sol", src)},
+    )
+    cfg = MythrilConfig(limits=L, transaction_count=1, max_steps=64,
+                        lanes_per_contract=4)
+    report = MythrilAnalyzer([c], cfg).fire_lasers(
+        modules=["AccidentallyKillable"])
+    body = json.loads(report.as_jsonv2())[0]
+    entry = [i for i in body["issues"] if i["swcID"] == "SWC-106"][0]
+    sm = entry["locations"][0]["sourceMap"]
+    off, length, fidx = (int(x) for x in sm.split(":"))
+    assert (off, length) == (16, 38), sm           # the srcmap span
+    assert body["sourceList"][fidx] == "Kill.sol"
