@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Minimal repro for the XLA:CPU JIT crash after many large compiles.
+
+Symptom (this environment: jax 0.4.x, CPU backend, 1 core): a single
+long-lived process that compiles ~50+ DISTINCT large XLA programs
+(sym_run-sized — hundreds of fused kernels each) segfaults inside the
+CPU JIT's code emission, with no Python traceback. The repo's test
+architecture exists around this bug: pytest.ini splits the suite over 4
+xdist workers (dividing per-process compile count) and test shapes are
+consolidated to a handful of (P, limits, max_steps) tuples.
+
+This script compiles the symbolic engine with a UNIQUE static shape per
+iteration until the process dies (or `--n` compiles complete). Run it
+standalone — intentionally NOT a pytest test:
+
+    JAX_PLATFORMS=cpu python tools/xla_cpu_segfault_repro.py --n 80
+
+Exit 0 = survived (bug absent/fixed in this jax build); a signal death
+(rc -11) = reproduced. See docs/xla-cpu-segfault.md for the decision
+record and the fences that keep production paths clear of the bug.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=80,
+                    help="distinct large programs to compile")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import mythril_tpu  # noqa: F401
+    from mythril_tpu.config import TEST_LIMITS
+    from mythril_tpu.core import Corpus, make_env
+    from mythril_tpu.disassembler import ContractImage
+    from mythril_tpu.disassembler.asm import erc20_like
+    from mythril_tpu.symbolic import SymSpec, make_sym_frontier, sym_run
+
+    img = ContractImage.from_bytecode(erc20_like(), TEST_LIMITS.max_code)
+    corpus = Corpus.from_images([img])
+    for i in range(args.n):
+        # a distinct max_steps per iteration forces a fresh compile of
+        # the full symbolic engine (the largest program in the repo)
+        steps = 16 + i
+        P = 8
+        active = np.zeros(P, dtype=bool)
+        active[0] = True
+        sf = make_sym_frontier(P, TEST_LIMITS, active=active)
+        out = sym_run(sf, make_env(P), corpus, SymSpec(), TEST_LIMITS,
+                      max_steps=steps)
+        out.base.pc.block_until_ready()
+        print(f"compile {i + 1}/{args.n} (max_steps={steps}) ok",
+              flush=True)
+    print("survived: bug not reproduced at this compile count")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
