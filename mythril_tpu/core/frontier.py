@@ -200,6 +200,14 @@ class Frontier:
     selfdestructed: jnp.ndarray  # bool[P] executed SELFDESTRUCT
     # --- metrics (reference: BenchmarkPlugin states/sec ⚠unv, SURVEY §5.1) ---
     n_steps: jnp.ndarray  # i32[P] instructions this lane actually executed
+    # per-opcode execution histogram (reference: --enable-iprof's
+    # InstructionProfiler table ⚠unv, SURVEY §5.1). None = disabled (the
+    # leaf vanishes from the pytree, so the hot path pays nothing); enable
+    # with `attach_iprof`. i32[P, 256], one row per lane so it shards with
+    # the lane axis; epilogue scatter-adds the executed opcode each
+    # superstep, expand_forks zeroes copies' rows (a fork child inherits
+    # its parent's PATH, not its parent's executed instructions).
+    op_hist: Optional[jnp.ndarray] = None
 
     @property
     def n_lanes(self) -> int:
@@ -220,6 +228,11 @@ class Frontier:
         fetch, PUSH immediates, CODESIZE/CODECOPY and JUMPDEST validation
         read the per-lane ``init_code`` buffer instead of the corpus)."""
         return (self.init_depth > 0) & (self.depth == self.init_depth)
+
+    def attach_iprof(self) -> "Frontier":
+        """Enable the per-opcode instruction profiler (zeroed histogram)."""
+        return self.replace(
+            op_hist=jnp.zeros((self.n_lanes, 256), dtype=jnp.int32))
 
     def trap(self, mask, code: int) -> "Frontier":
         """Set the error flag under ``mask``, attributing the FIRST cause."""
@@ -279,13 +292,22 @@ class Corpus:
     code: jnp.ndarray  # u8[C, MAX_CODE]
     code_len: jnp.ndarray  # i32[C]
     is_jumpdest: jnp.ndarray  # bool[C, MAX_CODE]
+    code_hash: jnp.ndarray  # u32[C, 8] keccak256 of each image, host-
+    # precomputed once so EXTCODEHASH answers concretely for corpus code
 
     @staticmethod
     def from_images(images) -> "Corpus":
+        from ..ops.keccak import keccak256_host_int
+
+        hashes = np.stack([
+            u256.from_int(keccak256_host_int(
+                bytes(np.asarray(im.code[:im.code_len], dtype=np.uint8))))
+            for im in images])
         return Corpus(
             code=jnp.asarray(np.stack([im.code for im in images])),
             code_len=jnp.asarray(np.array([im.code_len for im in images], dtype=np.int32)),
             is_jumpdest=jnp.asarray(np.stack([im.is_jumpdest for im in images])),
+            code_hash=jnp.asarray(hashes),
         )
 
 

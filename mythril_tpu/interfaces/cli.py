@@ -79,6 +79,13 @@ def create_parser() -> argparse.ArgumentParser:
     a.add_argument("--graph", metavar="PATH",
                    help="write the contract CFG as graphviz DOT, explored "
                         "blocks highlighted")
+    a.add_argument("--enable-iprof", action="store_true",
+                   help="print a per-opcode executed-instruction profile "
+                        "after the report")
+    a.add_argument("--plugin-dir", metavar="DIR",
+                   help="load external plugins (detection modules and/or "
+                        "laser plugins) from every *.py in DIR; installed "
+                        "entry-point plugins load automatically")
 
     a.add_argument("--corpus", metavar="DIR",
                    help="campaign mode: analyze every *.hex/*.bin under "
@@ -136,7 +143,10 @@ def create_parser() -> argparse.ArgumentParser:
     sf_.add_argument("--limits-profile", choices=["default", "test"],
                      default="default")
 
-    sub.add_parser("list-detectors", help="list registered detection modules")
+    ld = sub.add_parser("list-detectors",
+                        help="list registered detection modules")
+    ld.add_argument("--plugin-dir", metavar="DIR",
+                    help="also load external plugins from DIR first")
     sub.add_parser("version", help="print version")
     return p
 
@@ -176,6 +186,17 @@ def _load_contracts(args):
     raise SystemExit(2)
 
 
+def _discover_plugins(plugin_dir):
+    """Outer plugin discovery (entry points + optional directory); errors
+    warn on stderr rather than aborting the analysis."""
+    from ..plugin import discover
+
+    disc = discover(plugin_dir=plugin_dir)
+    for name, err in disc.errors.items():
+        print(f"warning: plugin {name}: {err}", file=sys.stderr)
+    return disc.laser_plugins
+
+
 def exec_analyze(args) -> int:
     import dataclasses
 
@@ -203,6 +224,8 @@ def exec_analyze(args) -> int:
         execution_timeout=args.execution_timeout,
         strategy=args.strategy,
         spec=SymSpec(storage=not args.concrete_storage),
+        enable_iprof=args.enable_iprof,
+        plugins=tuple(_discover_plugins(args.plugin_dir)),
     )
     analyzer = MythrilAnalyzer(contracts, cfg)
     modules = args.modules.split(",") if args.modules else None
@@ -217,6 +240,10 @@ def exec_analyze(args) -> int:
         print(report.as_markdown())
     else:
         print(report.as_text())
+    if args.enable_iprof:
+        # separate channel, like the reference's profiler dump: the report
+        # formats stay schema-stable whether or not profiling is on
+        print(analyzer.sym.iprof_table(), file=sys.stderr)
     return 0
 
 
@@ -240,6 +267,8 @@ def _exec_campaign(args) -> int:
         modules=args.modules.split(",") if args.modules else None,
         checkpoint_dir=args.checkpoint_dir,
         execution_timeout=args.execution_timeout,
+        plugins=tuple(_discover_plugins(args.plugin_dir)),
+        enable_iprof=args.enable_iprof,
     )
 
     def progress(done, total, dt, n_issues):
@@ -381,6 +410,7 @@ def exec_safe_functions(args) -> int:
 def exec_list_detectors(args) -> int:
     from ..analysis import ModuleLoader
 
+    _discover_plugins(getattr(args, "plugin_dir", None))
     for m in ModuleLoader().get_detection_modules():
         print(f"{m.name} (SWC-{m.swc_id}): {m.description}")
     return 0

@@ -64,6 +64,7 @@ class CampaignResult:
     dropped_forks: int = 0
     solver: Dict = field(default_factory=dict)
     batch_wall: List[float] = field(default_factory=list)
+    iprof: Dict[str, int] = field(default_factory=dict)  # opcode -> count
 
     def as_dict(self) -> Dict:
         # rates derive from the per-batch wall times, which the
@@ -90,6 +91,7 @@ class CampaignResult:
                 self.paths_total / total, 1) if total else 0.0,
             "dropped_forks": self.dropped_forks,
             "solver": self.solver,
+            **({"iprof": self.iprof} if self.iprof else {}),
         }
 
 
@@ -109,6 +111,8 @@ class CorpusCampaign:
         modules: Optional[Sequence[str]] = None,
         checkpoint_dir: Optional[str] = None,
         execution_timeout: Optional[float] = None,
+        plugins: Sequence = (),
+        enable_iprof: bool = False,
     ):
         self.contracts = list(contracts)
         self.batch_size = batch_size
@@ -120,6 +124,8 @@ class CorpusCampaign:
         self.modules = list(modules) if modules else None
         self.checkpoint_dir = checkpoint_dir
         self.execution_timeout = execution_timeout
+        self.plugins = list(plugins)
+        self.enable_iprof = enable_iprof
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -134,7 +140,7 @@ class CorpusCampaign:
             with open(p) as fh:
                 return json.load(fh)
         return {"next_batch": 0, "issues": [], "batch_wall": [],
-                "paths_total": 0, "dropped_forks": 0}
+                "paths_total": 0, "dropped_forks": 0, "iprof": {}}
 
     def _save_ckpt(self, state: Dict) -> None:
         p = self._ckpt_path
@@ -160,6 +166,7 @@ class CorpusCampaign:
         res.batch_wall = list(state["batch_wall"])
         res.paths_total = int(state["paths_total"])
         res.dropped_forks = int(state["dropped_forks"])
+        res.iprof = dict(state.get("iprof", {}))
         stats_at_start = SOLVER_STATS.snapshot()
 
         n_batches = (len(self.contracts) + self.batch_size - 1) // self.batch_size
@@ -179,6 +186,8 @@ class CorpusCampaign:
                 spec=self.spec, lanes_per_contract=self.lanes_per_contract,
                 max_steps=self.max_steps,
                 transaction_count=self.transaction_count,
+                plugins=self.plugins,
+                enable_iprof=self.enable_iprof,
             )
             report = fire_lasers(sym, white_list=self.modules)
             dt = time.monotonic() - t0
@@ -192,10 +201,14 @@ class CorpusCampaign:
             res.batch_wall.append(dt)
             res.paths_total += int(cov.get("surviving_paths", 0))
             res.dropped_forks += int(cov.get("dropped_forks", 0))
+            if self.enable_iprof:
+                for name, n in sym.iprof.items():
+                    res.iprof[name] = res.iprof.get(name, 0) + n
             state.update(next_batch=bi + 1, issues=res.issues,
                          batch_wall=res.batch_wall,
                          paths_total=res.paths_total,
-                         dropped_forks=res.dropped_forks)
+                         dropped_forks=res.dropped_forks,
+                         iprof=res.iprof)
             self._save_ckpt(state)
             if progress is not None:
                 progress(bi + 1, n_batches, dt, len(res.issues))

@@ -10,9 +10,12 @@ coverage/benchmark read state at boundaries, and the pruners
 into the engine (``between_txs`` / ``_note_backjump``).
 """
 
+from .discovery import (DiscoveredPlugins, discover, discover_entrypoints,
+                        load_plugin_dir)
 from .interface import LaserPlugin, PluginBuilder
 from .loader import LaserPluginLoader
 from .plugins import BenchmarkPlugin, CoveragePlugin
 
 __all__ = ["LaserPlugin", "PluginBuilder", "LaserPluginLoader",
-           "BenchmarkPlugin", "CoveragePlugin"]
+           "BenchmarkPlugin", "CoveragePlugin", "DiscoveredPlugins",
+           "discover", "discover_entrypoints", "load_plugin_dir"]

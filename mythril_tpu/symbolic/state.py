@@ -31,6 +31,30 @@ I32 = jnp.int32
 U32 = jnp.uint32
 
 
+def tape_row_hash(op, a, b, imm):
+    """u32 fingerprint of one tape row (op, a, b, imm[..., 8]).
+
+    The hash-cons scan in ``append_node`` compares this ONE word per
+    entry instead of the full 12-word row (3 x i32 + 8 x u32 imm) — the
+    full row is verified only for the single candidate the hash matched,
+    so a collision degrades to a missed dedup (sound: a duplicate node,
+    never a wrong id). Any writer of tape rows must store the matching
+    hash (``append_node`` and the seed rows in ``make_sym_frontier``).
+    """
+    op = jnp.asarray(op).astype(U32)
+    a = jnp.asarray(a).astype(U32)
+    b = jnp.asarray(b).astype(U32)
+    h = (op * U32(0x9E3779B1)) ^ (a * U32(0x85EBCA6B)) ^ (b * U32(0xC2B2AE35))
+    # positional odd multipliers: permuted limbs must hash differently
+    mult = jnp.asarray([0x27D4EB2F, 0x165667B1, 0xD6E8FEB9, 0xA3D8A6E3,
+                        0x83B58237, 0xCC9E2D51, 0x1B873593, 0xE6546B65],
+                       dtype=U32)
+    h = h ^ jnp.sum(imm.astype(U32) * mult, axis=-1, dtype=U32)
+    h = h ^ (h >> 16)
+    h = h * U32(0x7FEB352D)
+    return h ^ (h >> 15)
+
+
 @dataclass(frozen=True)
 class SymSpec:
     """Static (trace-time) choice of which inputs are symbolic.
@@ -88,6 +112,8 @@ class SymFrontier:
     tape_a: jnp.ndarray      # i32[P, T]
     tape_b: jnp.ndarray      # i32[P, T]
     tape_imm: jnp.ndarray    # u32[P, T, 8]
+    tape_hash: jnp.ndarray   # u32[P, T] row fingerprint (tape_row_hash) —
+    # the hash-cons scan's fast path; must stay in sync with every write
     tape_len: jnp.ndarray    # i32[P]
     havoc_cnt: jnp.ndarray   # i32[P] fresh-variable counter (HAVOC uniqueness)
     create_cnt: jnp.ndarray  # i32[P] CREATE/CREATE2 counter (fresh addresses)
@@ -254,6 +280,9 @@ def make_sym_frontier(
         tape_a=jnp.asarray(t_a),
         tape_b=jnp.asarray(t_b),
         tape_imm=jnp.zeros((P, T, 8), dtype=U32),
+        tape_hash=tape_row_hash(jnp.asarray(t_op), jnp.asarray(t_a),
+                                jnp.asarray(t_b),
+                                jnp.zeros((P, T, 8), dtype=U32)),
         tape_len=jnp.full(P, n_wk, dtype=I32),
         havoc_cnt=z(P),
         create_cnt=z(P),

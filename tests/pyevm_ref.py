@@ -289,7 +289,12 @@ class RefEVM:
             push(len(self.code) if a == self.env.address else 0)
         elif name == "RETURNDATASIZE":
             push(len(self.returndata))
-        elif name in ("EXTCODEHASH", "BLOCKHASH"):
+        elif name == "EXTCODEHASH":
+            a = st.pop()
+            # own code hashes for real (EIP-1052); the one-account world
+            # of this oracle answers 0 for everyone else
+            push(keccak256_host_int(self.code) if a == self.env.address else 0)
+        elif name == "BLOCKHASH":
             st.pop()
             push(0)
         elif name == "COINBASE":

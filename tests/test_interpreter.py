@@ -271,8 +271,9 @@ def test_env_ops():
         A(("push1", 0x99), "EXTCODESIZE", "STOP"),
         A(("push1", 1), "BLOCKHASH", "STOP"),
         A(("push1", 0), "EXTCODEHASH", "STOP"),
+        A("ADDRESS", "EXTCODEHASH", "STOP"),  # own image hash (EIP-1052)
     ]
-    cds += [cd] * 4 + [b""] * 6
+    cds += [cd] * 4 + [b""] * 7
     assert_all(progs, calldatas=cds, callvalue=123)
 
 

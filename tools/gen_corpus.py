@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Synthesize a BASELINE-config-3-style contract corpus.
+
+The reference's corpora are Etherscan-verified contracts; with no network
+in this image, the campaign dress run (SURVEY §6 / BASELINE config 3,
+VERDICT r3 ask #6) uses a synthetic mix authored with the in-repo
+assembler: per-index constant variation keeps every contract distinct
+(different storage slots, selectors, thresholds), and the mix covers
+vulnerable + safe shapes across several SWC classes so detection work is
+representative, not degenerate.
+
+Usage:  python tools/gen_corpus.py OUT_DIR [N]
+Then:   python -m mythril_tpu analyze --corpus OUT_DIR --batch-size 32 ...
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mythril_tpu.disassembler.asm import assemble
+
+
+def killable(i: int) -> bytes:
+    """SWC-106: caller-reachable SELFDESTRUCT (sweeps to the caller)."""
+    return assemble("CALLER", "SELFDESTRUCT")
+
+
+def guarded_killable(i: int) -> bytes:
+    """Safe sibling: only the stored owner can kill."""
+    return assemble(
+        i % 251, "SLOAD", "CALLER", "EQ", ("ref", "ok"), "JUMPI",
+        0, 0, "REVERT",
+        ("label", "ok"), "JUMPDEST", "CALLER", "SELFDESTRUCT")
+
+
+def add_overflow(i: int) -> bytes:
+    """SWC-101: unchecked add of calldata into storage."""
+    return assemble(
+        0, "CALLDATALOAD", i % 251, "SLOAD", "ADD", i % 251, "SSTORE",
+        "STOP")
+
+
+def checked_add(i: int) -> bytes:
+    """Safe sibling: SafeMath-style overflow guard."""
+    return assemble(
+        0, "CALLDATALOAD", i % 251, "SLOAD", "ADD",
+        "DUP1", i % 251, "SLOAD", "LT", ("ref", "bad"), "JUMPI",
+        i % 251, "SSTORE", "STOP",
+        ("label", "bad"), "JUMPDEST", 0, 0, "REVERT")
+
+
+def timestamp_gate(i: int) -> bytes:
+    """SWC-116: block.timestamp conditions a storage write."""
+    return assemble(
+        "TIMESTAMP", 1_700_000_000 + i, "LT", ("ref", "skip"), "JUMPI",
+        1, i % 251, "SSTORE",
+        ("label", "skip"), "JUMPDEST", "STOP")
+
+
+def origin_auth(i: int) -> bytes:
+    """SWC-115: tx.origin used for authorization."""
+    return assemble(
+        "ORIGIN", i % 251, "SLOAD", "EQ", ("ref", "ok"), "JUMPI",
+        0, 0, "REVERT",
+        ("label", "ok"), "JUMPDEST", 2, i % 251, "SSTORE", "STOP")
+
+
+def branchy_store(i: int) -> bytes:
+    """Path-explosion shape: 4 calldata branches into distinct writes."""
+    toks = []
+    for b in range(4):
+        toks += [32 * b, "CALLDATALOAD", ("ref", f"L{b}"), "JUMPI",
+                 ("label", f"L{b}"), "JUMPDEST"]
+    toks += [i & 0xFF, (i >> 8) % 251, "SSTORE", "STOP"]
+    return assemble(*toks)
+
+
+def plain_store(i: int) -> bytes:
+    """Quiet filler: single concrete write, no findings."""
+    return assemble(1 + (i % 254), i % 251, "SSTORE", "STOP")
+
+
+MIX = [killable, guarded_killable, add_overflow, checked_add,
+       timestamp_gate, origin_auth, branchy_store, plain_store]
+
+
+def main() -> int:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "corpus_synth"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    os.makedirs(out_dir, exist_ok=True)
+    for i in range(n):
+        gen = MIX[i % len(MIX)]
+        code = gen(i)
+        with open(os.path.join(out_dir, f"{gen.__name__}_{i:05d}.hex"),
+                  "w") as fh:
+            fh.write(code.hex())
+    print(f"{n} contracts -> {out_dir} "
+          f"({len(MIX)} shapes, per-index constants)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
