@@ -205,17 +205,62 @@ def bench_profile(timeout_s: float = 600.0) -> dict:
     return {"profile": prof}
 
 
+import threading as _threading
+
+_EMIT_LOCK = _threading.Lock()
+_EMITTED = False
+# headline result stashed as soon as it is measured, so a watchdog fire
+# during a LATER section (sym/analyze/profile overrunning the budget)
+# still reports the primary metric instead of value=0
+_HEADLINE = None  # (value, vs, unit_note, extra)
+
+
 def _emit(value, vs, unit_note, extra, error=None):
-    rec = {
-        "metric": "lane_steps_per_sec",
-        "value": round(float(value), 1),
-        "unit": "opcode-steps/s (%s)" % unit_note,
-        "vs_baseline": round(float(vs), 2),
-        "extra": extra,
-    }
-    if error:
-        rec["error"] = str(error)[:400]
-    print(json.dumps(rec))
+    """Print the ONE JSON line, exactly once, atomically w.r.t. the
+    watchdog thread (check-then-print under a lock: without it the timer
+    could os._exit mid-print, truncating the line, or both threads could
+    pass the flag check and print two lines)."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        rec = {
+            "metric": "lane_steps_per_sec",
+            "value": round(float(value), 1),
+            "unit": "opcode-steps/s (%s)" % unit_note,
+            "vs_baseline": round(float(vs), 2),
+            "extra": extra,
+        }
+        if error:
+            rec["error"] = str(error)[:400]
+        print(json.dumps(rec), flush=True)
+
+
+def _arm_watchdog(budget: float):
+    """A single XLA compile can exceed the whole driver budget (round 4:
+    cold-cache P=4096 compile > 580 s through the axon tunnel → the outer
+    timeout killed the process before ANY JSON was printed). A daemon
+    timer emits the error-shaped line just before the budget expires and
+    hard-exits; on a normal finish `_emit` has already printed and the
+    timer's emit is a no-op. The exit happens under the emit lock so it
+    can never kill the process while the main thread is mid-print."""
+
+    def fire():
+        err = ("watchdog: budget %.0fs expired mid-section "
+               "(likely a cold-cache XLA compile)" % budget)
+        if _HEADLINE is not None:  # headline measured before the overrun
+            value, vs, note, extra = _HEADLINE
+            _emit(value, vs, note, extra, error=err)
+        else:
+            _emit(0.0, 0.0, "no result", {}, error=err)
+        with _EMIT_LOCK:  # serialize with any in-flight main-thread emit
+            os._exit(0)
+
+    t = _threading.Timer(max(5.0, budget - 15.0), fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def _probe_backend(timeout_s: float = 75.0, retries: int = 2):
@@ -302,6 +347,7 @@ def main():
     # process at ~590 s — a partial JSON line beats a SIGKILL'd full one).
     # Each extra section only starts if its cost estimate still fits.
     budget = float(os.environ.get("MYTHRIL_BENCH_BUDGET", "520"))
+    _arm_watchdog(budget)
     t_start = time.monotonic()
 
     def remaining() -> float:
@@ -322,7 +368,11 @@ def main():
     if err:
         _emit(0.0, 0.0, "P=%d lanes, ERC20 transfer" % P, {}, error=err)
         return
+    global _HEADLINE
     extra = {"platform": jax.default_backend()}
+    note = "P=%d lanes, ERC20 transfer" % P
+    _HEADLINE = (value, vs, note, extra)  # extra mutates in place below,
+    # so later sections' partial results ride along on a watchdog emit
     if not os.environ.get("MYTHRIL_BENCH_NO_SYM"):
         if remaining() > 150:
             try:
@@ -347,14 +397,12 @@ def main():
                 extra["profile_error"] = repr(e)[:200]
         else:
             extra["profile_skipped"] = "budget: %.0fs left" % remaining()
-    _emit(value, vs, "P=%d lanes, ERC20 transfer" % P, extra)
+    _emit(value, vs, note, extra)
 
 
 if __name__ == "__main__":
     try:
         main()
     except BaseException as e:  # the one-JSON-line contract is absolute
-        print(json.dumps({"metric": "lane_steps_per_sec", "value": 0.0,
-                          "unit": "opcode-steps/s", "vs_baseline": 0.0,
-                          "error": "unhandled: %r" % (e,)}))
+        _emit(0.0, 0.0, "unhandled", {}, error="unhandled: %r" % (e,))
         raise SystemExit(0)

@@ -15,6 +15,16 @@ _REGISTRY: List[Type[DetectionModule]] = []
 
 
 def register_module(cls: Type[DetectionModule]) -> Type[DetectionModule]:
+    """Idempotent: repeated discovery passes (two analyses in one
+    process, a plugin dir re-imported under the same synthetic module
+    name) must not register a module twice — duplicates would make
+    ModuleLoader instantiate it twice and double every finding. Keyed by
+    (module, qualname) because a re-imported plugin file produces a NEW
+    class object with the same identity path."""
+    key = (cls.__module__, cls.__qualname__)
+    for existing in _REGISTRY:
+        if (existing.__module__, existing.__qualname__) == key:
+            return cls
     _REGISTRY.append(cls)
     return cls
 

@@ -136,7 +136,11 @@ def _write_slot(arr, widx, val):
     P, K = arr.shape[0], arr.shape[1]
     widx = widx.astype(I32)
     if _use_scatter():
-        return arr.at[jnp.arange(P), widx].set(val, mode="drop")
+        # same explicit dtype cast as the dense path: XLA's implicit
+        # unsafe scatter cast is deprecated (FutureWarning today, error
+        # in future JAX) and the two formulations must stay equivalent
+        return arr.at[jnp.arange(P), widx].set(
+            jnp.asarray(val, arr.dtype), mode="drop")
     tail = arr.shape[2:]
     sel = jnp.arange(K, dtype=I32)[None, :] == widx[:, None]
     val = jnp.broadcast_to(jnp.asarray(val, arr.dtype), (P,) + tail)
@@ -777,26 +781,72 @@ def prologue(f: Frontier, corpus: Corpus, berlin: bool = False):
     return f, op, run, f.pc
 
 
-# Classes whose handlers are cheap elementwise work can be applied
-# UNCONDITIONALLY every superstep (their lane mask already makes them a
-# no-op for other lanes), so XLA fuses them into one pass over the
-# frontier instead of materializing it at 16 `lax.cond` boundaries.
-# Classes with big inner loops (256-step division/exp, keccak rounds) or
-# whole-memory-window traffic stay behind `lax.cond` — a superstep must
-# not pay for them when no lane needs them.
+# Dispatch granularity: which classes hide behind `lax.cond` so a
+# superstep only pays for classes actually present in the frontier.
 #
-# The right split is BACKEND-DEPENDENT (tools/profile_superstep.py):
-# on XLA:CPU conds are nearly free and fusion across handlers is weak, so
-# gating everything wins (5.3 vs 9.0 ms/superstep at P=1024); on TPU each
-# cond is a fusion barrier that forces a full-frontier materialization,
-# so the cheap classes fuse. Resolved once at first trace.
+# MEASURED on the real chip (tools/profile_superstep.py via bench.py,
+# P=4096, ERC-20 workload, round 4):
+#     all_cond   3.88 ms/superstep   <- every class gated
+#     split      23.06 ms/superstep  <- cheap classes unconditional
+#     none_cond  763 ms/superstep    <- everything unconditional
+# The earlier hypothesis that TPU conds act as fusion barriers worth
+# avoiding was WRONG on hardware — an un-taken cond skips its handler's
+# whole-frontier reads/writes, which dominates any fusion benefit; the
+# 256-step DIV/EXP fori_loops make ungated dispatch catastrophic. On
+# XLA:CPU gating everything also wins (5.3 vs 9.0 ms/superstep at
+# P=1024). So: gate EVERYTHING, on every backend. COND_CLASSES is kept
+# for the profiler's A/B variants.
 COND_CLASSES = (CLS_MUL, CLS_DIVMOD, CLS_MODARITH, CLS_EXP, CLS_SHA3, CLS_COPY)
 
 
 def default_cond_classes() -> tuple:
-    if jax.default_backend() == "cpu":
-        return tuple(range(N_CLASSES))
-    return COND_CLASSES
+    return tuple(range(N_CLASSES))
+
+
+# Fields each class handler may WRITE. A gated class's `lax.cond`
+# returns ONLY these leaves — the other ~200 MB of frontier never become
+# cond outputs, so XLA cannot be forced to materialize them at the
+# boundary (measured: the narrow outputs are what make 16 sequential
+# conds affordable on TPU). The declaration is enforced at trace time:
+# an undeclared write raises AssertionError during the first jit.
+WRITE_FIELDS = {
+    CLS_STACK: ("stack", "sp"),
+    CLS_ALU: ("stack", "sp"),
+    CLS_MUL: ("stack", "sp"),
+    CLS_DIVMOD: ("stack", "sp"),
+    CLS_MODARITH: ("stack", "sp"),
+    CLS_EXP: ("stack", "sp", "gas_min", "gas_max"),
+    CLS_SHA3: ("stack", "sp", "gas_min", "gas_max", "mem_words",
+               "error", "err_code"),
+    CLS_ENV: ("stack", "sp"),
+    CLS_COPY: ("memory", "sp", "gas_min", "gas_max", "mem_words",
+               "error", "err_code"),
+    CLS_MEM: ("stack", "memory", "sp", "gas_min", "gas_max", "mem_words",
+              "error", "err_code"),
+    CLS_STORAGE: ("stack", "sp", "st_keys", "st_vals", "st_used",
+                  "st_written", "st_acct", "error", "err_code"),
+    CLS_JUMP: ("pc", "sp", "error", "err_code"),
+    CLS_HALT: ("halted", "reverted", "selfdestructed", "retval",
+               "retval_len", "gas_min", "gas_max", "mem_words", "sp",
+               "error", "err_code"),
+    CLS_LOG: ("n_logs", "log_pc", "log_cid", "log_ntopics", "log_topic0",
+              "log_data0", "sp", "gas_min", "gas_max", "mem_words",
+              "error", "err_code"),
+    CLS_CALL: ("stack", "sp", "returndata_len"),
+    CLS_CREATE: ("stack", "sp", "gas_min", "gas_max", "mem_words",
+                 "error", "err_code"),
+}
+
+_FRONTIER_FIELDS: Tuple[str, ...] = ()
+
+
+def _frontier_fields(f: Frontier):
+    global _FRONTIER_FIELDS
+    if not _FRONTIER_FIELDS:
+        import dataclasses
+
+        _FRONTIER_FIELDS = tuple(fl.name for fl in dataclasses.fields(f))
+    return _FRONTIER_FIELDS
 
 
 def dispatch(f: Frontier, env: Env, corpus: Corpus, op, run, old_pc,
@@ -816,15 +866,28 @@ def dispatch(f: Frontier, env: Env, corpus: Corpus, op, run, old_pc,
     present = jnp.any(
         (cls[:, None] == jnp.arange(N_CLASSES, dtype=cls.dtype)[None, :])
         & run[:, None], axis=0)
+    all_fields = _frontier_fields(f)
     for cid, handler in enumerate(_HANDLERS):
         mask = run & (cls == cid)
         if cid in cond_classes:
-            f = lax.cond(
+            names = WRITE_FIELDS[cid]
+
+            def _run_handler(fr=f, h=handler, mk=mask, names=names):
+                fr2 = h(fr, env, corpus, op, mk, old_pc)
+                for fld in all_fields:
+                    if fld not in names and \
+                            getattr(fr2, fld) is not getattr(fr, fld):
+                        raise AssertionError(
+                            f"{h.__name__} wrote undeclared field {fld!r}; "
+                            f"add it to WRITE_FIELDS[{cid}]")
+                return tuple(getattr(fr2, n) for n in names)
+
+            outs = lax.cond(
                 present[cid],
-                lambda fr, h=handler, mk=mask: h(fr, env, corpus, op, mk, old_pc),
-                lambda fr: fr,
-                f,
+                _run_handler,
+                lambda fr=f, names=names: tuple(getattr(fr, n) for n in names),
             )
+            f = f.replace(**dict(zip(names, outs)))
         else:
             f = handler(f, env, corpus, op, mask, old_pc)
     return f

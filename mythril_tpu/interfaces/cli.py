@@ -96,6 +96,14 @@ def create_parser() -> argparse.ArgumentParser:
                    help="contracts per compiled batch (campaign mode)")
     a.add_argument("--checkpoint-dir", metavar="DIR",
                    help="campaign checkpoint directory (resume-able)")
+    a.add_argument("--num-hosts", type=int, default=0, metavar="N",
+                   help="campaign mode: shard the corpus across N hosts; "
+                        "this process analyzes slice --host-index "
+                        "(default: jax.distributed process count when "
+                        "initialized, else 1)")
+    a.add_argument("--host-index", type=int, default=-1, metavar="I",
+                   help="which corpus shard this host takes (default: "
+                        "jax.distributed process index, else 0)")
     a.add_argument("-a", "--address", metavar="ADDRESS",
                    help="analyze the on-chain contract at ADDRESS "
                         "(requires --rpc)")
@@ -143,6 +151,12 @@ def create_parser() -> argparse.ArgumentParser:
     sf_.add_argument("--limits-profile", choices=["default", "test"],
                      default="default")
 
+    cm = sub.add_parser("campaign-merge",
+                        help="merge per-host campaign JSON results into "
+                             "corpus-level metrics")
+    cm.add_argument("results", nargs="+", metavar="JSON",
+                    help="campaign output files (one per host)")
+
     ld = sub.add_parser("list-detectors",
                         help="list registered detection modules")
     ld.add_argument("--plugin-dir", metavar="DIR",
@@ -179,6 +193,21 @@ def _load_contracts(args):
     if args.code:
         return [MythrilDisassembler.load_from_bytecode(args.code, name=args.name)]
     if args.codefile:
+        if args.codefile.endswith(".sol"):
+            # reference: `myth analyze contract.sol` (SURVEY §3.1) —
+            # requires a solc on PATH (or $MYTHRIL_SOLC)
+            from ..solidity import SolcError, SolcNotFound
+
+            try:
+                contracts = MythrilDisassembler.load_from_solidity(
+                    args.codefile)
+            except (SolcNotFound, SolcError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                raise SystemExit(2)
+            if not contracts:
+                print("error: no deployed bytecode compiled", file=sys.stderr)
+                raise SystemExit(2)
+            return contracts
         return [MythrilDisassembler.load_from_file(
             args.codefile, creation_path=args.creation_code, name=args.name)]
     print("error: provide bytecode via -c/--code, -f/--codefile, or --artifact",
@@ -247,6 +276,40 @@ def exec_analyze(args) -> int:
     return 0
 
 
+def _resolve_hosts(args):
+    """(num_hosts, host_index) for campaign sharding: explicit flags win;
+    an initialized jax.distributed runtime supplies pod defaults; a lone
+    process is host 0 of 1."""
+    n, i = args.num_hosts, args.host_index
+    if n <= 0 or i < 0:
+        try:  # initialized only on real multi-host launches
+            import jax
+
+            if jax.process_count() > 1:
+                n = n if n > 0 else jax.process_count()
+                i = i if i >= 0 else jax.process_index()
+        except Exception:  # noqa: BLE001 — backend may not be up yet
+            pass
+    n = n if n > 0 else 1
+    i = i if i >= 0 else 0
+    return n, i
+
+
+def exec_campaign_merge(args) -> int:
+    """Combine per-host campaign JSONs (reference has no analog — corpus
+    scale is this rebuild's north star; SURVEY §5.8 corpus sharding)."""
+    import json
+
+    from ..mythril.campaign import merge_campaigns
+
+    results = []
+    for p in args.results:
+        with open(p) as fh:
+            results.append(json.load(fh))
+    print(json.dumps(merge_campaigns(results), indent=1))
+    return 0
+
+
 def _exec_campaign(args) -> int:
     """Corpus campaign: BASELINE configs 2-3 (SURVEY §6)."""
     import json
@@ -256,6 +319,7 @@ def _exec_campaign(args) -> int:
     from ..symbolic import SymSpec
 
     contracts = load_corpus_dir(args.corpus)
+    num_hosts, host_index = _resolve_hosts(args)
     campaign = CorpusCampaign(
         contracts,
         batch_size=args.batch_size,
@@ -269,6 +333,8 @@ def _exec_campaign(args) -> int:
         execution_timeout=args.execution_timeout,
         plugins=tuple(_discover_plugins(args.plugin_dir)),
         enable_iprof=args.enable_iprof,
+        num_hosts=num_hosts,
+        host_index=host_index,
     )
 
     def progress(done, total, dt, n_issues):
@@ -433,6 +499,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return exec_hash_to_address(args)
     if args.command == "safe-functions":
         return exec_safe_functions(args)
+    if args.command == "campaign-merge":
+        return exec_campaign_merge(args)
     if args.command == "list-detectors":
         return exec_list_detectors(args)
     if args.command == "version":

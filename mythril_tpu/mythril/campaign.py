@@ -113,8 +113,26 @@ class CorpusCampaign:
         execution_timeout: Optional[float] = None,
         plugins: Sequence = (),
         enable_iprof: bool = False,
+        num_hosts: int = 1,
+        host_index: int = 0,
     ):
-        self.contracts = list(contracts)
+        # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
+        # for corpus sharding"): each host takes a deterministic strided
+        # slice — no coordination needed beyond the (num_hosts, host_index)
+        # pair, which jax.distributed provides as
+        # (process_count, process_index) on a real pod. Strided (not
+        # contiguous) so a sorted corpus's size gradient spreads evenly.
+        # Checkpoints are per-host files, so one shared checkpoint dir
+        # (NFS/GCS) serves the whole fleet; merge_campaigns() combines
+        # the per-host results into corpus-level metrics.
+        if not (0 <= host_index < num_hosts):
+            raise ValueError(f"host_index {host_index} not in [0, {num_hosts})")
+        self.num_hosts = num_hosts
+        self.host_index = host_index
+        contracts = list(contracts)
+        if num_hosts > 1:
+            contracts = contracts[host_index::num_hosts]
+        self.contracts = contracts
         self.batch_size = batch_size
         self.lanes_per_contract = lanes_per_contract
         self.limits = limits
@@ -132,15 +150,31 @@ class CorpusCampaign:
     def _ckpt_path(self) -> Optional[str]:
         if self.checkpoint_dir is None:
             return None
-        return os.path.join(self.checkpoint_dir, "campaign.json")
+        name = ("campaign.json" if self.num_hosts == 1
+                else f"campaign_host{self.host_index}.json")
+        return os.path.join(self.checkpoint_dir, name)
 
     def _load_ckpt(self) -> Dict:
         p = self._ckpt_path
         if p and os.path.exists(p):
             with open(p) as fh:
-                return json.load(fh)
+                state = json.load(fh)
+            # a checkpoint taken under a different sharding (or corpus)
+            # indexes a DIFFERENT contract slice — resuming it would
+            # silently skip contracts and double-attribute issues
+            shard = state.get("shard")
+            want = [self.num_hosts, self.host_index, len(self.contracts)]
+            if shard is not None and shard != want:
+                raise ValueError(
+                    f"checkpoint {p} was taken with (num_hosts, host_index,"
+                    f" shard_contracts)={shard}, current run is {want}; "
+                    "delete the checkpoint or relaunch with the original "
+                    "sharding")
+            return state
         return {"next_batch": 0, "issues": [], "batch_wall": [],
-                "paths_total": 0, "dropped_forks": 0, "iprof": {}}
+                "paths_total": 0, "dropped_forks": 0, "iprof": {},
+                "shard": [self.num_hosts, self.host_index,
+                          len(self.contracts)]}
 
     def _save_ckpt(self, state: Dict) -> None:
         p = self._ckpt_path
@@ -161,6 +195,8 @@ class CorpusCampaign:
         deadline = (None if self.execution_timeout is None
                     else t_start + self.execution_timeout)
         state = self._load_ckpt()
+        state.setdefault("shard", [self.num_hosts, self.host_index,
+                                   len(self.contracts)])
         res = CampaignResult()
         res.issues = list(state["issues"])
         res.batch_wall = list(state["batch_wall"])
@@ -219,3 +255,41 @@ class CorpusCampaign:
         res.compile_sec = res.batch_wall[0] if res.batch_wall else 0.0
         res.solver = SOLVER_STATS.delta(stats_at_start)
         return res
+
+
+def merge_campaigns(results: Sequence[Dict]) -> Dict:
+    """Combine per-host campaign result dicts (``as_dict()`` shape, with
+    optional ``issues_detail``) into corpus-level metrics. Hosts run
+    CONCURRENTLY on a pod, so merged wall-clock is the slowest host, while
+    throughput is the corpus total over that wall-clock."""
+    merged: Dict = {
+        "hosts": len(results),
+        "contracts": sum(r.get("contracts", 0) for r in results),
+        "batches": sum(r.get("batches", 0) for r in results),
+        "issues": sum(r.get("issues", 0) for r in results),
+        "wall_sec": max((r.get("wall_sec", 0.0) for r in results),
+                        default=0.0),
+        "paths_total": sum(r.get("paths_total", 0) for r in results),
+        "dropped_forks": sum(r.get("dropped_forks", 0) for r in results),
+    }
+    wall = merged["wall_sec"]
+    merged["contracts_per_sec"] = (
+        round(merged["contracts"] / wall, 3) if wall else 0.0)
+    merged["paths_per_sec"] = (
+        round(merged["paths_total"] / wall, 1) if wall else 0.0)
+    solver: Dict = {}
+    for r in results:
+        for k, v in (r.get("solver") or {}).items():
+            if isinstance(v, (int, float)):
+                solver[k] = solver.get(k, 0) + v
+    merged["solver"] = solver
+    iprof: Dict[str, int] = {}
+    for r in results:
+        for k, v in (r.get("iprof") or {}).items():
+            iprof[k] = iprof.get(k, 0) + v
+    if iprof:
+        merged["iprof"] = iprof
+    detail = [i for r in results for i in r.get("issues_detail", [])]
+    if detail:
+        merged["issues_detail"] = detail
+    return merged

@@ -72,9 +72,10 @@ class EVMContract:
 
 class MythrilDisassembler:
     """Loading front door (reference: ``MythrilDisassembler`` ⚠unv).
-    ``load_from_solidity`` is out of scope here (no solc in the image);
-    standard-JSON artifacts load via :meth:`load_from_bytecode` with the
-    artifact's deployedBytecode + bytecode fields."""
+    ``load_from_solidity`` shells out to solc when one is on PATH
+    (``MYTHRIL_SOLC`` overrides the binary); hermetic images without a
+    compiler load solc OUTPUT artifacts via standard-JSON ingestion or
+    raw bytecode via :meth:`load_from_bytecode`."""
 
     @staticmethod
     def load_from_bytecode(code, creation_code=None,
@@ -84,6 +85,17 @@ class MythrilDisassembler:
             creation_code=_to_bytes(creation_code) if creation_code else None,
             name=name,
         )
+
+    @staticmethod
+    def load_from_solidity(paths, solc_path=None):
+        """Compile ``.sol`` files with solc --standard-json and return
+        ``SolidityContract``s (source-mapped). Reference: SURVEY §3.1's
+        process boundary; raises ``SolcNotFound`` without a compiler."""
+        from ..solidity.soliditycontract import compile_solidity
+
+        if isinstance(paths, str):
+            paths = [paths]
+        return compile_solidity(list(paths), solc_path=solc_path)
 
     @staticmethod
     def load_from_file(path: str, creation_path: Optional[str] = None,

@@ -1,0 +1,71 @@
+"""Host-callback capability probe.
+
+The axon_pjrt TPU runtime used in this environment rejects
+``jax.pure_callback`` / ``io_callback`` outright
+("UNIMPLEMENTED: axon_pjrt does not support host send/recv callbacks") —
+measured round 4: the ecrecover host callback made ``sym_run`` fail to
+compile on the real chip while passing every CPU test. Standard TPU
+runtimes DO support callbacks, so this is a runtime property, not a
+platform property, and ``jax.default_backend()`` reports plain "tpu"
+either way. The only robust detection is an empirical probe: compile and
+run a trivial callback once per process and cache the verdict.
+
+Callers (the precompile dispatcher) choose at TRACE TIME between the
+host-callback path and the sound uninterpreted-leaf fallback, so an
+unsupported runtime costs precision (concrete ecrecover/bn128/blake2f
+degrade to havoc leaves), never correctness.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_CB_OK: Optional[bool] = None
+
+
+def host_callbacks_supported() -> bool:
+    """True iff jitted ``pure_callback`` works on the default backend.
+
+    MUST resolve OUTSIDE any active jax trace: probing while another
+    function is being traced embeds the probe's callback into the OUTER
+    jaxpr as a dead pjit equation, which axon then refuses to compile —
+    exactly the failure the probe exists to prevent (measured round 4:
+    bench's sym section failed while the later analyze section, served by
+    the cached verdict, passed). The engine module triggers an eager
+    probe at import; if this is nonetheless first called mid-trace, the
+    verdict is a conservative False for that trace (not cached)."""
+    global _CB_OK
+    if _CB_OK is None:
+        forced = os.environ.get("MYTHRIL_HOST_CALLBACKS")
+        if forced is not None:
+            _CB_OK = forced not in ("0", "off", "no")
+            return _CB_OK
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            if not jax.core.trace_state_clean():
+                log.warning(
+                    "host-callback probe requested mid-trace; answering "
+                    "False for this trace (probe at import next time)")
+                return False  # deliberately NOT cached
+        except Exception:  # noqa: BLE001 — trace-state API drift
+            pass
+        try:
+            out = jax.jit(
+                lambda x: jax.pure_callback(
+                    lambda a: a,
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    x,
+                )
+            )(jnp.int32(7))
+            _CB_OK = int(out) == 7
+        except Exception as e:  # noqa: BLE001 — any failure means "no"
+            log.info("host callbacks unavailable on %s: %r",
+                     jax.default_backend(), e)
+            _CB_OK = False
+    return _CB_OK

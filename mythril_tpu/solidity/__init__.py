@@ -1,8 +1,10 @@
-"""Solidity artifact frontend (reference: ``mythril/solidity/`` ⚠unv)."""
+"""Solidity frontend (reference: ``mythril/solidity/`` ⚠unv)."""
 
-from .soliditycontract import (SolidityContract, SourceMapEntry,
+from .soliditycontract import (SolcError, SolcNotFound, SolidityContract,
+                               SourceMapEntry, compile_solidity,
                                get_contracts_from_standard_json,
                                parse_srcmap)
 
-__all__ = ["SolidityContract", "SourceMapEntry",
+__all__ = ["SolcError", "SolcNotFound", "SolidityContract",
+           "SourceMapEntry", "compile_solidity",
            "get_contracts_from_standard_json", "parse_srcmap"]

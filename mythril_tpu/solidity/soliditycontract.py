@@ -1,11 +1,17 @@
-"""solc standard-JSON artifact ingestion + source maps.
+"""Solidity frontend: solc subprocess + standard-JSON ingestion + srcmaps.
 
 Reference: ``mythril/solidity/soliditycontract.py`` (⚠unv, SURVEY.md §2
-row "Solidity frontend") shells out to solc; this image has no solc, so
-the frontend consumes solc's OUTPUT artifact (standard-JSON with
-``evm.deployedBytecode.object`` + ``sourceMap``) — the same data, one
-process boundary earlier. Issues then map to source lines, which the
-reference's golden reports include (VERDICT r2 missing #6).
+row "Solidity frontend") shells out to solc. Two paths here:
+
+- :func:`compile_solidity` runs ``solc --standard-json`` when a compiler
+  is on PATH (gated — this image carries none; the subprocess protocol
+  is stub-tested);
+- :func:`get_contracts_from_standard_json` consumes solc's OUTPUT
+  artifact (``evm.deployedBytecode.object`` + ``sourceMap``) — the same
+  data, one process boundary earlier, for hermetic environments.
+
+Issues then map to source lines, which the reference's golden reports
+include (VERDICT r2 missing #6).
 
 Source-map format (solc docs, public spec): ``s:l:f:j:m`` entries
 separated by ``;``, empty fields inheriting the previous entry; one entry
@@ -15,6 +21,7 @@ per INSTRUCTION of the deployed code.
 from __future__ import annotations
 
 import json
+import os
 import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple, Union
@@ -85,6 +92,77 @@ class SolidityContract:
             snippet = content[e.offset: e.offset + e.length]
             loc["snippet"] = re.sub(r"\s+", " ", snippet)[:120]
         return loc
+
+
+def make_standard_json_input(sources: Dict[str, str]) -> dict:
+    """Compiler INPUT document for ``{path: source_text}`` requesting the
+    artifacts the frontend consumes (deployed/creation bytecode + srcmaps)."""
+    return {
+        "language": "Solidity",
+        "sources": {name: {"content": text} for name, text in sources.items()},
+        "settings": {
+            "outputSelection": {
+                "*": {"*": ["evm.bytecode.object",
+                            "evm.deployedBytecode.object",
+                            "evm.deployedBytecode.sourceMap"]}
+            }
+        },
+    }
+
+
+def compile_solidity(paths: List[str],
+                     solc_path: Optional[str] = None,
+                     timeout: float = 120.0) -> List[SolidityContract]:
+    """Shell out to ``solc --standard-json`` and ingest the result.
+
+    Reference: ``SolidityContract`` invoking solc as a subprocess
+    (``mythril/solidity/soliditycontract.py`` + ``ethereum/util.py``
+    ⚠unv, SURVEY.md §3.1 "PROCESS BOUNDARY"). This image carries no solc
+    binary, so the path is GATED: a missing compiler raises a clear
+    ``SolcNotFound`` naming the artifact-ingestion alternative, and tests
+    drive the subprocess protocol with a stub solc (same standard-JSON
+    contract either way)."""
+    import shutil
+    import subprocess
+
+    solc = solc_path or os.environ.get("MYTHRIL_SOLC", "solc")
+    if shutil.which(solc) is None:
+        raise SolcNotFound(
+            f"solc binary {solc!r} not found on PATH; compile offline and "
+            "load the standard-JSON artifact instead "
+            "(get_contracts_from_standard_json)")
+    sources = {}
+    for p in paths:
+        with open(p) as fh:
+            sources[p] = fh.read()
+    inp = make_standard_json_input(sources)
+    try:
+        r = subprocess.run([solc, "--standard-json"],
+                           input=json.dumps(inp), capture_output=True,
+                           text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        raise SolcError(f"solc timed out after {timeout:.0f}s") from e
+    if r.returncode != 0:
+        raise SolcError(f"solc exited {r.returncode}: {r.stderr[:500]}")
+    try:
+        out = json.loads(r.stdout)
+    except json.JSONDecodeError as e:
+        raise SolcError(f"solc emitted invalid JSON: {e}") from e
+    errors = [e for e in out.get("errors", [])
+              if e.get("severity") == "error"]
+    if errors:
+        raise SolcError("; ".join(
+            e.get("formattedMessage", e.get("message", "?"))[:200]
+            for e in errors[:5]))
+    return get_contracts_from_standard_json(out, inp)
+
+
+class SolcNotFound(RuntimeError):
+    """No solc on PATH (expected in hermetic images — use artifacts)."""
+
+
+class SolcError(RuntimeError):
+    """solc ran but failed (compile errors, bad output)."""
 
 
 def get_contracts_from_standard_json(

@@ -790,9 +790,10 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
 CREATE_ADDR_BASE = 0xC0DE00000000  # fresh pseudo-addresses for CREATE results
 
 
-PRE_IN_CAP = 320  # precompile input window cap (modexp header + 3x32-byte
-# operands = 192; sha256/identity accept up to this; longer inputs fall to
-# the external-havoc path, counted like any unresolved call)
+PRE_IN_CAP = 448  # precompile input window cap (modexp header + 3x32-byte
+# operands = 192; a 2-pair ECPAIRING check — the common signature-verify
+# shape — is 384; sha256/identity accept up to this; longer inputs fall
+# to the external-havoc path, counted like any unresolved call)
 
 
 def _be_window_word(buf, start, width, INW: int):
@@ -810,20 +811,25 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
                        r_len) -> SymFrontier:
     """Execute precompile calls 0x1-0x9 for the `pre` lanes.
 
-    Reference: ``mythril/laser/ethereum/natives.py`` (⚠unv). Modeled:
+    Reference: ``mythril/laser/ethereum/natives.py`` (⚠unv) — all nine
+    computed concretely there; same here:
 
     - 0x2 sha256: device kernel on concrete input;
     - 0x4 identity: byte copy;
     - 0x5 modexp: device square-and-multiply for <= 32-byte operands;
-    - 0x1 ecrecover: uninterpreted ECRECOVER leaf per call site (the
-      reference models the symbolic case the same way; no secp256k1 on
-      device — concrete recovery is not computed, documented);
-    - 0x3 ripemd160, 0x6-0x8 bn128, 0x9 blake2f: fresh PRECOMPILE leaf
-      (sound havoc).
+    - 0x1 ecrecover: host callback (ops/secp256k1) on concrete input,
+      uninterpreted ECRECOVER leaf per call site otherwise;
+    - 0x3 ripemd160, 0x6/0x7/0x8 alt_bn128 add/mul/pairing, 0x9 blake2f:
+      one batched host callback (ops/natives_host) on concrete input.
+      A malformed input (off-curve point, bad blake2f length/flag) FAILS
+      the call: success word rewritten to 0, empty returndata — the one
+      precompile-failure channel the EVM has. A blake2f rounds word past
+      ``BLAKE2F_MAX_ROUNDS`` falls to the sound havoc leaf instead of
+      stalling the host (DoS fence, documented there).
 
-    Symbolic input bytes demote the concrete cases (2/4/5) to the leaf
-    path. Success is always pushed by the caller; gas for precompiles is
-    not charged (static min/max tables only — documented).
+    Symbolic input bytes demote every concrete case to the leaf path.
+    Gas: per-native schedules charged below (modexp the EIP-2565 floor
+    only — its input-dependent formula is not modeled, documented).
     """
     f = sf.base
     P, M = f.memory.shape
@@ -842,9 +848,14 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
     inp = jnp.where(jnp.arange(INW)[None, :] < a_len[:, None], inp, 0)
 
     conc = pre & ~sym_in
+    # trace-time capability gate: the axon runtime has no host callbacks
+    # (ops/callbacks.py) — without them, concrete ecrecover and the
+    # ripemd/bn128/blake2f natives degrade to the sound leaf path
+    from ..ops.callbacks import host_callbacks_supported
+    cb_ok = host_callbacks_supported()
     m_sha = conc & (pid == 2)
     m_id = conc & (pid == 4)
-    m_ecr = conc & (pid == 1)
+    m_ecr = conc & (pid == 1) & cb_ok
 
     # modexp header: three 32-byte big-endian lengths
     blen = u256.to_u64_saturating(ci._be_bytes_to_word(inp[:, 0:32])).astype(I64)
@@ -856,7 +867,18 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
             & (mlen >= 0) & (mlen <= 32)
             & (96 + blen + elen + mlen <= a_len))
     m_mod = conc & (pid == 5) & fits
-    m_leaf = pre & ~m_sha & ~m_id & ~m_mod & ~m_ecr
+    # blake2f rounds word (first 4 input bytes, big-endian) read on device
+    # so an attacker-size rounds count routes to the leaf, not the host
+    rounds = ((inp[:, 0].astype(I64) << 24) | (inp[:, 1].astype(I64) << 16)
+              | (inp[:, 2].astype(I64) << 8) | inp[:, 3].astype(I64))
+    from ..ops.natives_host import BLAKE2F_MAX_ROUNDS
+    m_host = conc & cb_ok & (
+        (pid == 3) | (pid == 6) | (pid == 7) | (pid == 8)
+        | ((pid == 9) & (rounds <= BLAKE2F_MAX_ROUNDS))
+    )
+    if RD < 64:  # tiny test shapes: no room for the 64-byte outputs
+        m_host = m_host & (pid == 3)
+    m_leaf = pre & ~m_sha & ~m_id & ~m_mod & ~m_ecr & ~m_host
 
     # concrete ecrecover via host callback (VERDICT r3 weak #6; reference
     # uses libsecp256k1 ⚠unv — here ops/secp256k1, pure Python, memoized).
@@ -883,12 +905,50 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
             inp, m_ecr,
         )
 
-    ecr_bytes, ecr_ok = lax.cond(
-        jnp.any(m_ecr), _run_ecr,
-        lambda _: (jnp.zeros((P, 32), dtype=jnp.uint8),
-                   jnp.zeros((P,), dtype=jnp.bool_)),
-        0,
-    )
+    # `if cb_ok` (a trace-time Python bool) keeps the callback custom-call
+    # OUT of the traced program entirely on runtimes that reject it —
+    # even an un-taken cond branch containing it fails axon compilation
+    if cb_ok:
+        ecr_bytes, ecr_ok = lax.cond(
+            jnp.any(m_ecr), _run_ecr,
+            lambda _: (jnp.zeros((P, 32), dtype=jnp.uint8),
+                       jnp.zeros((P,), dtype=jnp.bool_)),
+            0,
+        )
+    else:
+        ecr_bytes = jnp.zeros((P, 32), dtype=jnp.uint8)
+        ecr_ok = jnp.zeros((P,), dtype=jnp.bool_)
+
+    # ripemd160 / bn128 / blake2f: one batched host callback (rare path,
+    # gated like ecrecover). ok=False = the precompile call itself fails.
+    def _host_nat(inp_np, pid_np, alen_np, mask_np):
+        from ..ops.natives_host import natives_batch
+
+        return natives_batch(inp_np, pid_np, alen_np, mask_np)
+
+    def _run_nat(_):
+        return jax.pure_callback(
+            _host_nat,
+            (jax.ShapeDtypeStruct((P, 64), jnp.uint8),
+             jax.ShapeDtypeStruct((P,), jnp.int32),
+             jax.ShapeDtypeStruct((P,), jnp.bool_)),
+            inp, pid, a_len, m_host,
+        )
+
+    if cb_ok:
+        nat_bytes, nat_len, nat_ok = lax.cond(
+            jnp.any(m_host), _run_nat,
+            lambda _: (jnp.zeros((P, 64), dtype=jnp.uint8),
+                       jnp.zeros((P,), dtype=jnp.int32),
+                       jnp.zeros((P,), dtype=jnp.bool_)),
+            0,
+        )
+    else:
+        nat_bytes = jnp.zeros((P, 64), dtype=jnp.uint8)
+        nat_len = jnp.zeros((P,), dtype=jnp.int32)
+        nat_ok = jnp.zeros((P,), dtype=jnp.bool_)
+    m_hok = m_host & nat_ok
+    m_hfail = m_host & ~nat_ok
 
     from ..ops.sha256 import sha256_device
     sha_w = lax.cond(
@@ -907,15 +967,17 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
     )
 
     # precompile gas (reference: natives.py per-native schedules ⚠unv);
-    # modexp charges the EIP-2565 floor and pairing its base — the full
-    # input-dependent formulas are not modeled (documented)
+    # modexp charges the EIP-2565 floor — its full input-dependent
+    # formula is not modeled (documented); pairing is the EIP-1108
+    # per-pair schedule; blake2f charges its concrete rounds word
     words = (a_len + 31) // 32
     pcost = jnp.select(
         [pid == 1, pid == 2, pid == 3, pid == 4, pid == 5,
-         pid == 6, pid == 7, pid == 8],
+         pid == 6, pid == 7, pid == 8, pid == 9],
         [3000, 60 + 12 * words, 600 + 120 * words, 15 + 3 * words,
          jnp.full_like(words, 200), jnp.full_like(words, 150),
-         jnp.full_like(words, 6000), jnp.full_like(words, 45000)],
+         jnp.full_like(words, 6000), 45000 + 34000 * (a_len // 192),
+         rounds],
         default=jnp.zeros_like(words),
     )
     f = ci._charge(f, pre, pcost)
@@ -933,6 +995,8 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
                                   jnp.where((pid == 6) | (pid == 7) | (pid == 9),
                                             64, 32))).astype(I64)
     out_len = jnp.where(m_ecr, jnp.where(ecr_ok, 32, 0), out_len)
+    out_len = jnp.where(m_host, jnp.where(nat_ok, nat_len, 0).astype(I64),
+                        out_len)
     out = jnp.where(m_id[:, None], inp[:, :RD] if INW >= RD else
                     jnp.pad(inp, ((0, 0), (0, RD - INW))), 0).astype(jnp.uint8)
     sha_bytes = ci._word_to_be_bytes(sha_w)  # u8[P,32]
@@ -949,9 +1013,13 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
     out = jnp.where(m_mod[:, None] & (kk < mlen[:, None]), mod_bytes, out)
     out = jnp.where((m_ecr & ecr_ok)[:, None] & head,
                     jnp.pad(ecr_bytes, ((0, 0), (0, max(0, RD - 32)))), out)
+    nat_pad = (jnp.pad(nat_bytes, ((0, 0), (0, RD - 64))) if RD >= 64
+               else nat_bytes[:, :RD])
+    out = jnp.where(m_hok[:, None] & (kk < nat_len[:, None].astype(I64)),
+                    nat_pad, out)
 
     # returndata buffer + memory window write
-    conc_res = m_sha | m_id | m_mod | m_ecr
+    conc_res = m_sha | m_id | m_mod | m_ecr | m_hok
     n_out = jnp.clip(out_len, 0, RD).astype(I32)
     returndata = jnp.where(pre[:, None], out, f.returndata)
     returndata = jnp.where(
@@ -979,8 +1047,13 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
         m_leaf & (r_len > 0) & ~leaf_word_ok
     )
 
+    # a malformed input FAILS the call: the success word the caller
+    # pushed (top of stack after the sp update) is rewritten to 0
+    stack = ci._set_slot(f.stack, f.sp - 1,
+                         jnp.zeros((P, 8), dtype=U32), m_hfail)
+
     return sf.replace(
-        base=f.replace(memory=memory, returndata=returndata,
+        base=f.replace(memory=memory, returndata=returndata, stack=stack,
                        returndata_len=jnp.where(pre, n_out, f.returndata_len)),
         mem_sym=mem_sym,
         mem_havoc=mem_havoc,
@@ -2433,8 +2506,17 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
         fork_req_new = jnp.zeros_like(new.fork_req)
     if b.op_hist is not None:
         # iprof: a fork copy starts with an empty executed-op histogram —
-        # its pre-fork instructions were already counted on the source lane
-        b = b.replace(op_hist=jnp.where(is_copy[:, None], 0, b.op_hist))
+        # its pre-fork instructions were already counted on the source
+        # lane. But the RECYCLED slot may hold a retired lane's not-yet-
+        # harvested counts (harvest only runs at tx boundaries): fold
+        # those rows into a surviving lane's row before zeroing — the
+        # harvest sums every row, so totals are conserved.
+        dead_rows = jnp.sum(
+            jnp.where(is_copy[:, None], sf.base.op_hist, 0), axis=0)
+        tgt = jnp.argmax(b.active & ~is_copy).astype(I32)
+        b = b.replace(
+            op_hist=jnp.where(is_copy[:, None], 0, b.op_hist)
+            .at[tgt].add(dead_rows))
     new = new.replace(
         base=b.replace(
             pc=pc_new,
@@ -2510,8 +2592,12 @@ def rebalance_parked(sf: SymFrontier, fork_block: int = 0):
     b = new.base.replace(active=new.base.active.at[src].set(False))
     if b.op_hist is not None:
         # iprof: the lane's counts moved with it; the vacated slot must
-        # not keep a stale copy (the harvest sums every row)
-        b = b.replace(op_hist=b.op_hist.at[src].set(0))
+        # not keep a stale copy (the harvest sums every row), and the
+        # DESTINATION slots' pre-move rows (a retired lane's unharvested
+        # counts) must not vanish — fold them into the first moved row
+        dead_rows = jnp.sum(sf.base.op_hist[dst], axis=0)
+        b = b.replace(
+            op_hist=b.op_hist.at[src].set(0).at[dst[0]].add(dead_rows))
     return new.replace(
         base=b,
         fork_req=new.fork_req.at[src].set(False),
@@ -2575,3 +2661,14 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
 
     _, sf, visited = lax.while_loop(cond, body, (jnp.int32(0), sf, visited0))
     return (sf, visited) if track_coverage else sf
+
+
+# Resolve the host-callback capability now, at import — OUTSIDE any jax
+# trace. Probing lazily from inside a traced `_apply_precompiles` embeds
+# the probe's callback into the outer program as dead code, which the
+# axon runtime then refuses to compile (ops/callbacks.py has the full
+# story). Import of this module already initializes the backend (the
+# jnp metadata tables above), so this adds one trivial extra compile.
+from ..ops.callbacks import host_callbacks_supported as _probe_host_callbacks  # noqa: E402
+
+_probe_host_callbacks()

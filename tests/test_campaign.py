@@ -71,3 +71,45 @@ def test_campaign_checkpoint_resume(tmp_path):
     assert resumed.batches == 2
     assert ({i["contract"] for i in resumed.issues}
             == {i["contract"] for i in full.issues})
+
+
+def test_campaign_multihost_shard_and_merge(tmp_path):
+    """Two 'hosts' each analyze a strided corpus shard; the merged result
+    matches the single-host run issue-for-issue (SURVEY §5.8 corpus
+    sharding — the one communication the corpus layer needs)."""
+    from mythril_tpu.mythril.campaign import merge_campaigns
+
+    corpus = write_corpus(tmp_path)
+    single = make_campaign(corpus).run()
+
+    def host(i):
+        return CorpusCampaign(
+            load_corpus_dir(corpus),
+            batch_size=4, lanes_per_contract=8, limits=TEST_LIMITS,
+            max_steps=64, transaction_count=1,
+            modules=["AccidentallyKillable"],
+            checkpoint_dir=str(tmp_path / "ck_mh"),  # SHARED dir
+            num_hosts=2, host_index=i,
+        )
+
+    r0, r1 = host(0).run(), host(1).run()
+    assert r0.contracts == 3 and r1.contracts == 3
+    d0, d1 = r0.as_dict(), r1.as_dict()
+    d0["issues_detail"], d1["issues_detail"] = r0.issues, r1.issues
+    merged = merge_campaigns([d0, d1])
+    assert merged["hosts"] == 2
+    assert merged["contracts"] == single.contracts
+    assert ({i["contract"] for i in merged["issues_detail"]}
+            == {i["contract"] for i in single.issues})
+    assert merged["solver"]["attempts"] > 0
+    # per-host checkpoints coexist in the shared dir
+    assert (tmp_path / "ck_mh" / "campaign_host0.json").exists()
+    assert (tmp_path / "ck_mh" / "campaign_host1.json").exists()
+
+
+def test_campaign_host_index_validation(tmp_path):
+    import pytest
+
+    corpus = write_corpus(tmp_path)
+    with pytest.raises(ValueError, match="host_index"):
+        CorpusCampaign(load_corpus_dir(corpus), num_hosts=2, host_index=2)

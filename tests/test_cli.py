@@ -198,3 +198,43 @@ def test_safe_functions(capsys):
     assert rc == 0
     assert "totalSupply()" in out, out
     assert "kill()" not in out, out
+
+
+def test_analyze_sol_file_via_stub_solc(tmp_path, capsys, monkeypatch):
+    """`analyze -f contract.sol` drives the solc subprocess seam
+    (round 4; reference: `myth analyze contract.sol`, SURVEY §3.1)."""
+    import sys as _sys
+
+    sol = tmp_path / "k.sol"
+    sol.write_text("contract K { }\n")
+    stub = tmp_path / "solc"
+    stub.write_text(
+        f"#!{_sys.executable}\n"
+        "import json, sys\n"
+        "inp = json.load(sys.stdin)\n"
+        "name = list(inp['sources'])[0]\n"
+        "out = {'sources': {name: {'id': 0}}, 'contracts': {name: {'K': {\n"
+        "  'evm': {'deployedBytecode': {'object': '%s',\n"
+        "                               'sourceMap': '0:5:0:-'}}}}}}\n"
+        "json.dump(out, sys.stdout)\n" % KILLABLE
+    )
+    stub.chmod(0o755)
+    monkeypatch.setenv("MYTHRIL_SOLC", str(stub))
+    rc, out = run_cli(
+        capsys, "analyze", "-f", str(sol), "-t", "1",
+        "--max-steps", "32", "--lanes-per-contract", "4",
+        "--limits-profile", "test", "-m", "AccidentallyKillable",
+        "-o", "json",
+    )
+    assert rc == 0
+    doc = json.loads(out)
+    assert any(i["swc-id"] == "106" for i in doc["issues"])
+
+
+def test_analyze_sol_without_solc_fails_clearly(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_SOLC", str(tmp_path / "missing-solc"))
+    sol = tmp_path / "k.sol"
+    sol.write_text("contract K { }\n")
+    with pytest.raises(SystemExit) as ei:
+        main(["analyze", "-f", str(sol)])
+    assert ei.value.code == 2

@@ -200,3 +200,73 @@ def test_jsonv2_carries_real_srcmap(tmp_path):
     off, length, fidx = (int(x) for x in sm.split(":"))
     assert (off, length) == (16, 38), sm           # the srcmap span
     assert body["sourceList"][fidx] == "Kill.sol"
+
+
+# --- solc subprocess front door (round 4) --------------------------------
+
+
+def test_solc_subprocess_compile(tmp_path):
+    """Drive compile_solidity through a STUB solc that speaks the
+    standard-JSON protocol (no real compiler in this image — the
+    subprocess seam is what's under test; artifact ingestion past the
+    seam is covered above)."""
+    import sys as _sys
+
+    from mythril_tpu.mythril.orchestration import MythrilDisassembler
+
+    code = assemble(1, 0, "SSTORE", "STOP")
+    sol = tmp_path / "c.sol"
+    sol.write_text("contract C { uint x; }\n")
+    stub = tmp_path / "solc"
+    stub.write_text(
+        f"#!{_sys.executable}\n"
+        "import json, sys\n"
+        "inp = json.load(sys.stdin)\n"
+        "assert inp['language'] == 'Solidity'\n"
+        "assert '--standard-json' in sys.argv\n"
+        "name = list(inp['sources'])[0]\n"
+        "out = {'sources': {name: {'id': 0}}, 'contracts': {name: {'C': {\n"
+        "  'evm': {'bytecode': {'object': '%s'},\n"
+        "          'deployedBytecode': {'object': '%s',\n"
+        "                               'sourceMap': '0:10:0:-'}}}}}}\n"
+        "json.dump(out, sys.stdout)\n" % (code.hex(), code.hex())
+    )
+    stub.chmod(0o755)
+
+    cs = MythrilDisassembler.load_from_solidity(str(sol), solc_path=str(stub))
+    assert len(cs) == 1 and cs[0].name == "C"
+    assert cs[0].code == code and cs[0].creation_code == code
+    loc = cs[0].source_location(0)
+    assert loc and loc["lineno"] == 1 and loc["filename"] == str(sol)
+
+
+def test_solc_missing_raises_clear_error(tmp_path):
+    from mythril_tpu.solidity.soliditycontract import SolcNotFound
+    from mythril_tpu.mythril.orchestration import MythrilDisassembler
+
+    sol = tmp_path / "c.sol"
+    sol.write_text("contract C {}\n")
+    with pytest.raises(SolcNotFound, match="standard-JSON"):
+        MythrilDisassembler.load_from_solidity(
+            str(sol), solc_path=str(tmp_path / "definitely-not-solc"))
+
+
+def test_solc_compile_error_surfaces(tmp_path):
+    import sys as _sys
+
+    from mythril_tpu.solidity.soliditycontract import SolcError, compile_solidity
+
+    sol = tmp_path / "bad.sol"
+    sol.write_text("contract {\n")
+    stub = tmp_path / "solc"
+    stub.write_text(
+        f"#!{_sys.executable}\n"
+        "import json, sys\n"
+        "json.load(sys.stdin)\n"
+        "json.dump({'errors': [{'severity': 'error',\n"
+        "  'formattedMessage': 'ParserError: expected identifier'}]},\n"
+        "  sys.stdout)\n"
+    )
+    stub.chmod(0o755)
+    with pytest.raises(SolcError, match="ParserError"):
+        compile_solidity([str(sol)], solc_path=str(stub))

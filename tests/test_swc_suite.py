@@ -190,3 +190,32 @@ def test_storage_gated_transfer_is_tod():
     )
     report = analyze(code)
     assert "114" in swcs(report)
+
+
+# suite-wide undecided-rate bound: the snapshot fixture runs before the
+# first test IN THIS FILE (xdist --dist loadfile runs files whole, so the
+# delta at the last test spans exactly this suite's queries)
+import pytest  # noqa: E402
+
+from mythril_tpu.smt.solver import SOLVER_STATS  # noqa: E402
+
+_stats0 = {}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _snapshot_solver_stats():
+    _stats0["snap"] = SOLVER_STATS.snapshot()
+    yield
+
+
+def test_unknown_rate_bound_across_suite():
+    """VERDICT r3 ask #4 done-criterion: across the SWC-suite fixtures the
+    solver must DECIDE (sat or unsat) >= 90% of queries — every unknown is
+    a silently dropped candidate finding. Runs last in this file (pytest
+    preserves definition order)."""
+    d = SOLVER_STATS.delta(_stats0["snap"])
+    decided = d["sat"] + d["unsat"]
+    total = decided + d["unknown"]
+    assert total >= 10, f"suite exercised too few solver queries: {d}"
+    assert d["unknown"] / total < 0.10, (
+        f"undecided rate {d['unknown']}/{total} breaches the 10% bound: {d}")

@@ -24,8 +24,11 @@ from mythril_tpu.disassembler.asm import assemble
 
 
 def killable(i: int) -> bytes:
-    """SWC-106: caller-reachable SELFDESTRUCT (sweeps to the caller)."""
-    return assemble("CALLER", "SELFDESTRUCT")
+    """SWC-106: caller-reachable SELFDESTRUCT (sweeps to the caller).
+    The dead PUSH keeps every instance byte-distinct like the other
+    generators (a constant body would let dedup collapse 1/8 of the
+    corpus and skew the dress-run numbers)."""
+    return assemble(i % 251, "POP", "CALLER", "SELFDESTRUCT")
 
 
 def guarded_killable(i: int) -> bytes:
