@@ -209,6 +209,16 @@ import threading as _threading
 
 _EMIT_LOCK = _threading.Lock()
 _EMITTED = False
+
+
+def _safe_copy(d):
+    """Copy a dict the other thread may be mutating; never raise."""
+    for _ in range(3):
+        try:
+            return dict(d)
+        except RuntimeError:
+            continue
+    return {"partial": "extra dict was mutating during watchdog emit"}
 # headline result stashed as soon as it is measured, so a watchdog fire
 # during a LATER section (sym/analyze/profile overrunning the budget)
 # still reports the primary metric instead of value=0
@@ -224,17 +234,21 @@ def _emit(value, vs, unit_note, extra, error=None):
     with _EMIT_LOCK:
         if _EMITTED:
             return
-        _EMITTED = True
         rec = {
             "metric": "lane_steps_per_sec",
             "value": round(float(value), 1),
             "unit": "opcode-steps/s (%s)" % unit_note,
             "vs_baseline": round(float(vs), 2),
-            "extra": extra,
+            # snapshot: the main thread may still be inserting keys when
+            # the watchdog serializes ("dict changed size during
+            # iteration" would otherwise lose the line entirely)
+            "extra": _safe_copy(extra),
         }
         if error:
             rec["error"] = str(error)[:400]
-        print(json.dumps(rec), flush=True)
+        line = json.dumps(rec)
+        _EMITTED = True  # only after a successful serialize
+        print(line, flush=True)
 
 
 def _arm_watchdog(budget: float):

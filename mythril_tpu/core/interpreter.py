@@ -849,6 +849,53 @@ def _frontier_fields(f: Frontier):
     return _FRONTIER_FIELDS
 
 
+def _key_name(k) -> str:
+    for attr in ("name", "key", "idx"):
+        v = getattr(k, attr, None)
+        if v is not None:
+            return str(v)
+    return str(k)
+
+
+def narrow_cond(pred, fn, obj, declared):
+    """``lax.cond(pred, fn, identity, obj)`` whose cond OUTPUTS are only
+    the leaves under the ``declared`` dotted field paths — the rest of the
+    pytree bypasses the cond entirely, so XLA cannot be forced to
+    materialize untouched state at the boundary (same trick as
+    ``dispatch``'s WRITE_FIELDS, generalized to nested pytrees like
+    SymFrontier where writes land both on ``base.stack`` and on overlay
+    fields). ``fn`` must write ONLY under ``declared``; an undeclared
+    write raises at first trace."""
+    import jax.tree_util as jtu
+
+    kl, treedef = jtu.tree_flatten_with_path(obj)
+    names = [".".join(_key_name(k) for k in path) for path, _ in kl]
+
+    def is_declared(n: str) -> bool:
+        return any(n == d or n.startswith(d + ".") for d in declared)
+
+    idxs = [i for i, n in enumerate(names) if is_declared(n)]
+
+    def _true():
+        new = fn(obj)
+        new_kl, _ = jtu.tree_flatten_with_path(new)
+        for (_, b), (_, a), n in zip(new_kl, kl, names):
+            if b is not a and not is_declared(n):
+                raise AssertionError(
+                    f"{getattr(fn, '__name__', fn)} wrote undeclared leaf "
+                    f"{n!r}; add it to the declared write set")
+        return tuple(new_kl[i][1] for i in idxs)
+
+    def _false():
+        return tuple(kl[i][1] for i in idxs)
+
+    outs = lax.cond(pred, _true, _false)
+    leaves = [leaf for _, leaf in kl]
+    for j, i in enumerate(idxs):
+        leaves[i] = outs[j]
+    return jtu.tree_unflatten(treedef, leaves)
+
+
 def dispatch(f: Frontier, env: Env, corpus: Corpus, op, run, old_pc,
              skip=None, cond_classes=None) -> Frontier:
     """Run the per-class handlers over the frontier. ``skip`` masks lanes

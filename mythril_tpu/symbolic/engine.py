@@ -2080,6 +2080,31 @@ def _berlin_gas_post(sf: SymFrontier, op, run, key_w, key_s) -> SymFrontier:
     ))
 
 
+# declared write sets for the narrow claimed-handler conds (dotted paths
+# into the SymFrontier pytree; enforced at trace time by ci.narrow_cond)
+_TAPE_WRITES = ("tape_op", "tape_a", "tape_b", "tape_imm", "tape_hash",
+                "tape_len")
+_STORAGE_WRITES = (
+    "base.stack", "base.sp", "base.st_keys", "base.st_vals", "base.st_used",
+    "base.st_written", "base.st_acct", "base.error", "base.err_code",
+    "stack_sym", "st_key_sym", "st_val_sym", "dep_read",
+    "sstore_after_call_pc", "sstore_ac_cid", "arb_key_node", "arb_key_pc",
+    "arb_key_cid",
+) + _TAPE_WRITES
+_JUMP_WRITES = (
+    "base.pc", "base.sp", "base.halted", "base.error", "base.err_code",
+    "con_node", "con_sign", "con_pc", "con_len",
+    "sym_jump_dest", "sym_jump_pc", "sym_jump_cid", "fork_req", "fork_dest",
+)
+_MISC_WRITES = (
+    "base.sp", "base.halted", "base.reverted", "base.retval_len",
+    "base.n_logs", "base.log_pc", "base.log_cid", "base.log_ntopics",
+    "base.log_topic0", "base.error", "base.err_code",
+    "havoc_cnt", "log_topic0_sym", "log_data0_sym", "stack_sym",
+    "mem_havoc", "rv_havoc",
+) + _TAPE_WRITES
+
+
 def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
                   spec: SymSpec = SymSpec(),
                   limits: LimitsConfig = DEFAULT_LIMITS) -> SymFrontier:
@@ -2126,19 +2151,31 @@ def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
     def _cond_apply(sf, mask, fn):
         return lax.cond(jnp.any(mask), fn, lambda x: x, sf)
 
-    sf = _cond_apply(sf, claim_storage,
-                     lambda x: _h_sym_storage(x, spec, op, claim_storage))
-    sf = _cond_apply(sf, claim_jump,
-                     lambda x: _h_sym_jump(x, corpus, op, claim_jump, old_pc, known, ksign))
+    # the hot claimed handlers run behind NARROW conds (ci.narrow_cond):
+    # only their declared write sets become cond outputs, keeping the
+    # rest of the SymFrontier (frame stacks, memory, calldata overlays)
+    # out of the boundary. CALL/CREATE write half the frontier and fire
+    # rarely — they keep the plain full-state cond.
+    sf = ci.narrow_cond(jnp.any(claim_storage),
+                        lambda x: _h_sym_storage(x, spec, op, claim_storage),
+                        sf, _STORAGE_WRITES)
+    sf = ci.narrow_cond(
+        jnp.any(claim_jump),
+        lambda x: _h_sym_jump(x, corpus, op, claim_jump, old_pc, known,
+                              ksign),
+        sf, _JUMP_WRITES)
     sf = _cond_apply(sf, claim_call,
                      lambda x: _h_sym_call(x, corpus, op, claim_call, old_pc,
                                            spec, limits))
     sf = _cond_apply(sf, claim_create,
                      lambda x: _h_sym_create(x, op, claim_create, old_pc))
     misc = claim_memoff | claim_sha3off | claim_copyoff | claim_haltoff | claim_logoff
-    sf = _cond_apply(sf, misc,
-                     lambda x: _h_sym_claimed_misc(x, op, claim_memoff, claim_sha3off,
-                                                   claim_copyoff, claim_haltoff, claim_logoff))
+    sf = ci.narrow_cond(
+        jnp.any(misc),
+        lambda x: _h_sym_claimed_misc(x, op, claim_memoff, claim_sha3off,
+                                      claim_copyoff, claim_haltoff,
+                                      claim_logoff),
+        sf, _MISC_WRITES)
 
     if berlin:
         sf = _berlin_gas_post(sf, op, run, a[0], s[0])
@@ -2649,13 +2686,24 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
             pc = jnp.clip(s.base.pc, 0, MC - 1)
             visited = visited.at[cid, pc].set(True, mode="drop")
         s = sym_superstep(s, env, corpus, spec, limits)
-        s = expand_forks(s, limits.loop_bound, fork_block, fork_policy,
-                         defer_starved,
-                         visited if track_coverage else None)
+        # expand_forks tree-gathers EVERY leaf of the frontier; gate it so
+        # supersteps with no pending fork request (the common case) skip
+        # that full-frontier pass. Identity-valued when no live request.
+        s = lax.cond(
+            jnp.any(s.fork_req & s.base.active),
+            lambda x: expand_forks(x, limits.loop_bound, fork_block,
+                                   fork_policy, defer_starved,
+                                   visited if track_coverage else None),
+            lambda x: x,
+            s,
+        )
         if propagate_every:
-            s = lax.cond(
+            s = ci.narrow_cond(
                 (i % propagate_every) == propagate_every - 1,
-                kill_infeasible, lambda x: x, s,
+                kill_infeasible, s,
+                ("iv_lo", "iv_hi", "kb_m", "kb_v", "prop_len",
+                 "base.active", "fork_req", "killed_infeasible",
+                 "killed_total"),
             )
         return i + 1, s, visited
 

@@ -94,10 +94,47 @@ def main():
         return go
 
     variants = {
-        "split": tuple(ci.COND_CLASSES),          # new: cheap classes fused
-        "all_cond": tuple(range(ci.N_CLASSES)),   # round-3 behavior
+        "split": tuple(ci.COND_CLASSES),          # cheap classes fused
+        "all_cond": tuple(range(ci.N_CLASSES)),   # current default
         "none_cond": (),                          # everything unconditional
     }
+
+    def make_empty_cond_runner():
+        """Same 16-cond structure as all_cond but every handler replaced
+        by identity: isolates fixed per-cond overhead from handler
+        compute (if this ~equals all_cond, the conds ARE the cost)."""
+        def step(fr):
+            fr, op, run_m, old_pc = ci.prologue(fr, corpus)
+            cls_v = ci._J_CLASS[op]
+            present = jnp.any(
+                (cls_v[:, None] == jnp.arange(ci.N_CLASSES,
+                                              dtype=cls_v.dtype)[None, :])
+                & run_m[:, None], axis=0)
+            for cid in range(ci.N_CLASSES):
+                names = ci.WRITE_FIELDS[cid]
+                outs = lax.cond(
+                    present[cid],
+                    lambda fr=fr, names=names: tuple(
+                        getattr(fr, n) for n in names),
+                    lambda fr=fr, names=names: tuple(
+                        getattr(fr, n) for n in names),
+                )
+                fr = fr.replace(**dict(zip(names, outs)))
+            return ci.epilogue(fr, op, run_m, old_pc)
+
+        @jax.jit
+        def go(fr):
+            # fixed-trip loop: with handlers disabled lanes trap on stack
+            # arity almost immediately, so the usual `running` exit would
+            # end after ~2 supersteps and time nothing
+            def body(st):
+                i, x = st
+                return i + 1, step(x)
+
+            return lax.while_loop(lambda st: st[0] < MAX_STEPS, body,
+                                  (jnp.int32(0), fr))[1]
+
+        return go
     # PROF_VARIANTS selects a subset (compiles through a slow tunnel can
     # make the full 4-variant sweep blow a wall-clock budget — one
     # variant per process keeps each session to a single big compile)
@@ -114,10 +151,19 @@ def main():
         steps = int(np.asarray(out.n_steps).max())
         prof[f"{name}_wall_s"] = round(dt, 4)
         prof[f"{name}_superstep_ms"] = round(dt / max(steps, 1) * 1e3, 4)
+        # sanity: a dispatch variant that broke execution produces absurd
+        # timings — record enough to see it
+        prof[f"{name}_ok_lanes"] = int(np.asarray(
+            out.halted & ~out.error).sum())
+        prof[f"{name}_steps_max"] = steps
     if "skeleton" in sel:
         sk = make_runner((), skeleton=True)
         dt = timed(sk, f, reps=REPS)
         prof["skeleton_superstep_ms"] = round(dt / MAX_STEPS * 1e3, 4)
+    if "empty_conds" in sel:
+        ec = make_empty_cond_runner()
+        dt = timed(ec, f, reps=REPS)
+        prof["empty_conds_superstep_ms"] = round(dt / MAX_STEPS * 1e3, 4)
 
     if out is not None:
         steps_sum = int(np.asarray(out.n_steps).sum())
