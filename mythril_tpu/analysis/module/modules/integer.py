@@ -22,7 +22,7 @@ from typing import List
 import numpy as np
 
 from ....symbolic.ops import SymOp
-from ....smt.tape import HostNode, HostTape
+from ....smt.tape import HostNode, HostTape, intern_node
 from ....smt.solver import solve_tape
 from ...report import Issue
 from ..base import DetectionModule, EntryPoint
@@ -63,20 +63,23 @@ class IntegerArithmetics(DetectionModule):
                 base = ctx.tape(lane)
                 nodes = list(base.nodes)
                 cons = list(base.constraints)
+                # predicate nodes are INTERNED onto the path tape: a
+                # SafeMath guard asserts the very same LT node, and the
+                # shared id lets the refuter prove guarded ops UNSAT
                 if op == 0x01:  # ADD
-                    nodes.append(HostNode(int(SymOp.LT), r, a, 0))
-                    cons.append((len(nodes) - 1, True))
+                    cons.append((intern_node(
+                        nodes, HostNode(int(SymOp.LT), r, a, 0)), True))
                     word = "overflow"
                 elif op == 0x03:  # SUB
-                    nodes.append(HostNode(int(SymOp.LT), a, b, 0))
-                    cons.append((len(nodes) - 1, True))
+                    cons.append((intern_node(
+                        nodes, HostNode(int(SymOp.LT), a, b, 0)), True))
                     word = "underflow"
                 elif op == 0x02:  # MUL
-                    nodes.append(HostNode(int(SymOp.ISZERO), b, 0, 0))
-                    cons.append((len(nodes) - 1, False))
-                    nodes.append(HostNode(int(SymOp.DIV), r, b, 0))
-                    nodes.append(HostNode(int(SymOp.EQ), len(nodes) - 1, a, 0))
-                    cons.append((len(nodes) - 1, False))
+                    cons.append((intern_node(
+                        nodes, HostNode(int(SymOp.ISZERO), b, 0, 0)), False))
+                    did = intern_node(nodes, HostNode(int(SymOp.DIV), r, b, 0))
+                    cons.append((intern_node(
+                        nodes, HostNode(int(SymOp.EQ), did, a, 0)), False))
                     word = "overflow"
                 else:
                     continue  # EXP: v1 skip

@@ -20,7 +20,8 @@ from ..core.frontier import ATTACKER_ADDRESS, CAP_TRAPS, TRAP_NAMES
 from ..disassembler import ContractImage
 from ..smt.eval import Assignment
 from ..smt.solver import solve_tape
-from ..smt.tape import HostTape, TapeHostCache, extract_tape
+from ..smt.tape import (HostNode, HostTape, TapeHostCache, extract_tape,
+                        intern_node)
 from ..symbolic import SymSpec, between_txs, make_sym_frontier, sym_run
 from ..symbolic.engine import rebalance_parked
 
@@ -73,12 +74,34 @@ class AnalysisContext:
     def solve(self, lane: int, extra_constraints=(),
               extra_nodes=()) -> Optional[Assignment]:
         """Witness for the lane's path condition + extra (node, sign)
-        constraints. ``extra_nodes`` are appended to the tape first (ids
-        continue after the lane's last node) so modules can constrain
-        derived predicates without touching the device tape."""
+        constraints. ``extra_nodes`` are INTERNED onto the tape (callers
+        still address them as if appended at ``len(tape.nodes)+k`` —
+        constraint ids in that range are remapped): a predicate node the
+        path already carries shares its id, so an already-asserted
+        opposite sign becomes a provable polarity conflict (unsat)
+        instead of an exhausted witness search (unknown)."""
+        from ..symbolic.ops import SymOp
+
         base = self.tape(lane)
-        t = HostTape(nodes=list(base.nodes) + list(extra_nodes),
-                     constraints=list(base.constraints) + list(extra_constraints))
+        nodes = list(base.nodes)
+        n0 = len(nodes)
+        remap = []
+        for n in extra_nodes:
+            # an extra node may reference an earlier extra node by its
+            # pre-intern (positional) id — but ONLY ops whose operands
+            # ARE node ids get remapped: FREE carries (kind, index) and
+            # CONST carries payload, either of which can numerically
+            # exceed n0 without being a reference
+            a, b = n.a, n.b
+            if n.op not in (int(SymOp.FREE), int(SymOp.CONST)):
+                a = remap[a - n0] if a >= n0 else a
+                b = remap[b - n0] if b >= n0 else b
+            remap.append(intern_node(nodes, HostNode(n.op, a, b, n.imm)))
+        cons = list(base.constraints) + [
+            (remap[i - n0] if i >= n0 else i, s)
+            for i, s in extra_constraints
+        ]
+        t = HostTape(nodes=nodes, constraints=cons)
         return solve_tape(t, max_iters=self.solver_iters)
 
     def contract_of(self, lane: int) -> int:

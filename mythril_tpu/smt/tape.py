@@ -31,6 +31,22 @@ class HostTape:
     pcs: List[int] = field(default_factory=list)  # branch pc per constraint (may be shorter)
 
 
+def intern_node(nodes: List[HostNode], node: HostNode) -> int:
+    """Id of `node` in `nodes`, appending only when absent — the host
+    analog of the device tape's hash-consing. Detection modules MUST
+    build attack predicates through this: a predicate that re-creates a
+    node the path already asserts (e.g. the LT(a,b) a SafeMath guard
+    branched on) then shares its id, so the refuter sees the polarity
+    conflict and proves UNSAT instead of burning witness-search budget
+    into an `unknown` (round 4: this was every second solver query on
+    the ERC-20 workload)."""
+    try:
+        return nodes.index(node)
+    except ValueError:
+        nodes.append(node)
+        return len(nodes) - 1
+
+
 def support(tape: HostTape, root: int):
     """(leaf node ids, FreeKind set) reachable from `root` (iterative)."""
     ids, kinds, seen, stack = [], set(), set(), [root]

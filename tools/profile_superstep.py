@@ -65,7 +65,18 @@ def tree_bytes(t) -> int:
 
 
 def main():
-    code, f, env, corpus = erc20_transfer_workload(P, DEFAULT_LIMITS)
+    limits = DEFAULT_LIMITS
+    if os.environ.get("PROF_STACK") or os.environ.get("PROF_MEM"):
+        import dataclasses
+
+        limits = dataclasses.replace(
+            DEFAULT_LIMITS,
+            max_stack=int(os.environ.get("PROF_STACK",
+                                         DEFAULT_LIMITS.max_stack)),
+            mem_bytes=int(os.environ.get("PROF_MEM",
+                                         DEFAULT_LIMITS.mem_bytes)),
+        )
+    code, f, env, corpus = erc20_transfer_workload(P, limits)
     res = {"backend": jax.default_backend(), "P": P, "max_steps": MAX_STEPS,
            "frontier_bytes": tree_bytes(f), "corpus_bytes": tree_bytes(corpus)}
 
