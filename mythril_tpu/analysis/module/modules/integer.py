@@ -62,24 +62,28 @@ class IntegerArithmetics(DetectionModule):
                 r = int(arith_r[lane, j])
                 base = ctx.tape(lane)
                 nodes = list(base.nodes)
+                idx = dict(ctx.tape_index(lane))
                 cons = list(base.constraints)
                 # predicate nodes are INTERNED onto the path tape: a
                 # SafeMath guard asserts the very same LT node, and the
                 # shared id lets the refuter prove guarded ops UNSAT
                 if op == 0x01:  # ADD
                     cons.append((intern_node(
-                        nodes, HostNode(int(SymOp.LT), r, a, 0)), True))
+                        nodes, HostNode(int(SymOp.LT), r, a, 0), idx), True))
                     word = "overflow"
                 elif op == 0x03:  # SUB
                     cons.append((intern_node(
-                        nodes, HostNode(int(SymOp.LT), a, b, 0)), True))
+                        nodes, HostNode(int(SymOp.LT), a, b, 0), idx), True))
                     word = "underflow"
                 elif op == 0x02:  # MUL
                     cons.append((intern_node(
-                        nodes, HostNode(int(SymOp.ISZERO), b, 0, 0)), False))
-                    did = intern_node(nodes, HostNode(int(SymOp.DIV), r, b, 0))
+                        nodes, HostNode(int(SymOp.ISZERO), b, 0, 0), idx),
+                        False))
+                    did = intern_node(nodes, HostNode(int(SymOp.DIV), r, b, 0),
+                                      idx)
                     cons.append((intern_node(
-                        nodes, HostNode(int(SymOp.EQ), did, a, 0)), False))
+                        nodes, HostNode(int(SymOp.EQ), did, a, 0), idx),
+                        False))
                     word = "overflow"
                 else:
                     continue  # EXP: v1 skip

@@ -44,6 +44,7 @@ class AnalysisContext:
     timed_out: bool = False
     _tapes: Dict[int, HostTape] = field(default_factory=dict)
     _tape_cache: Optional[TapeHostCache] = field(default=None, repr=False)
+    _tape_idx: Dict[int, dict] = field(default_factory=dict, repr=False)
 
     def lanes(self, include_errors: bool = False,
               include_reverted: bool = False) -> np.ndarray:
@@ -71,6 +72,16 @@ class AnalysisContext:
                                              cache=self._tape_cache)
         return self._tapes[lane]
 
+    def tape_index(self, lane: int) -> dict:
+        """Cached ``node_index`` of the lane's base tape. Callers that
+        intern extra nodes must COPY it (``dict(...)``) first — the cached
+        index must keep describing the unmutated base tape."""
+        if lane not in self._tape_idx:
+            from ..smt.tape import node_index
+
+            self._tape_idx[lane] = node_index(self.tape(lane).nodes)
+        return self._tape_idx[lane]
+
     def solve(self, lane: int, extra_constraints=(),
               extra_nodes=()) -> Optional[Assignment]:
         """Witness for the lane's path condition + extra (node, sign)
@@ -84,6 +95,7 @@ class AnalysisContext:
 
         base = self.tape(lane)
         nodes = list(base.nodes)
+        idx = dict(self.tape_index(lane))
         n0 = len(nodes)
         remap = []
         for n in extra_nodes:
@@ -96,7 +108,7 @@ class AnalysisContext:
             if n.op not in (int(SymOp.FREE), int(SymOp.CONST)):
                 a = remap[a - n0] if a >= n0 else a
                 b = remap[b - n0] if b >= n0 else b
-            remap.append(intern_node(nodes, HostNode(n.op, a, b, n.imm)))
+            remap.append(intern_node(nodes, HostNode(n.op, a, b, n.imm), idx))
         cons = list(base.constraints) + [
             (remap[i - n0] if i >= n0 else i, s)
             for i, s in extra_constraints

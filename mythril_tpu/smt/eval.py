@@ -122,6 +122,17 @@ class Assignment:
         self.tx(tx).write_word(off, value)
 
 
+#: FreeKinds whose values live in ``Assignment.by_node`` (keyed by node
+#: id, not by (kind, index)). SINGLE source of truth — the assigner
+#: (solver._assign_leaf), the evaluator (_free_value) and the
+#: independence partitioner (solver._leaf_keys) all key off this tuple.
+BY_NODE_KINDS = (
+    int(FreeKind.STORAGE), int(FreeKind.RETVAL), int(FreeKind.HAVOC),
+    int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH),
+    int(FreeKind.ECRECOVER), int(FreeKind.PRECOMPILE),
+)
+
+
 def _free_value(node_id: int, kind: int, index: int, asn: Assignment) -> int:
     if kind == int(FreeKind.CALLDATA_WORD):
         return asn.tx(index // TX_STRIDE).read_word(index % TX_STRIDE)
@@ -134,9 +145,7 @@ def _free_value(node_id: int, kind: int, index: int, asn: Assignment) -> int:
     if kind == int(FreeKind.CALLDATASIZE):
         t = asn.tx(index)
         return t.calldatasize if t.calldatasize is not None else len(t.calldata)
-    if kind in (int(FreeKind.STORAGE), int(FreeKind.RETVAL), int(FreeKind.HAVOC),
-                int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH),
-                int(FreeKind.ECRECOVER), int(FreeKind.PRECOMPILE)):
+    if kind in BY_NODE_KINDS:
         return asn.by_node.get(node_id, 0)
     # block-env leaves default to plausible mainnet-ish values
     defaults = {

@@ -41,6 +41,7 @@ class SolverStatistics:
     unknown: int = 0
     cache_hits: int = 0
     time_sec: float = 0.0
+    partitioned: int = 0  # queries split into >1 independent cluster
 
     def record(self, verdict: str, dt: float, cached: bool = False) -> None:
         self.attempts += 1
@@ -58,10 +59,12 @@ class SolverStatistics:
         self.attempts = self.sat = self.unsat = self.unknown = 0
         self.cache_hits = 0
         self.time_sec = 0.0
+        self.partitioned = 0
 
     def snapshot(self) -> "SolverStatistics":
         return SolverStatistics(self.attempts, self.sat, self.unsat,
-                                self.unknown, self.cache_hits, self.time_sec)
+                                self.unknown, self.cache_hits, self.time_sec,
+                                self.partitioned)
 
     def delta(self, since: "SolverStatistics") -> dict:
         return {
@@ -70,6 +73,7 @@ class SolverStatistics:
             "unsat": self.unsat - since.unsat,
             "unknown": self.unknown - since.unknown,
             "cache_hits": self.cache_hits - since.cache_hits,
+            "partitioned": self.partitioned - since.partitioned,
             "time_sec": round(self.time_sec - since.time_sec, 3),
         }
 
@@ -77,6 +81,7 @@ class SolverStatistics:
         return {
             "attempts": self.attempts, "sat": self.sat, "unsat": self.unsat,
             "unknown": self.unknown, "cache_hits": self.cache_hits,
+            "partitioned": self.partitioned,
             "time_sec": round(self.time_sec, 3),
         }
 
@@ -217,9 +222,9 @@ def _assign_leaf(node_id: int, nd, target: int, asn: Assignment) -> bool:
     if kind == int(FreeKind.CALLDATASIZE):
         asn.tx(nd.b).calldatasize = target
         return True
-    if kind in (int(FreeKind.STORAGE), int(FreeKind.RETVAL), int(FreeKind.HAVOC),
-                int(FreeKind.RETDATASIZE), int(FreeKind.BLOCKHASH),
-                int(FreeKind.ECRECOVER), int(FreeKind.PRECOMPILE)):
+    from .eval import BY_NODE_KINDS
+
+    if kind in BY_NODE_KINDS:
         asn.by_node[node_id] = target
         return True
     asn.scalars[(kind, nd.b)] = target
@@ -239,6 +244,119 @@ def _leaf_support(tape: HostTape, root: int) -> List[int]:
         else:
             stack.extend((nd.a, nd.b))
     return out
+
+
+# --- independence partitioning (reference: IndependenceSolver,
+# ``laser/smt/solver/independence_solver.py`` ⚠unv, SURVEY §2.1 "SMT
+# solvers" — "partitions constraint set into independent clusters
+# (shared-variable union-find) and solves separately — the reference's
+# main solver optimization"). Here independence is computed at the
+# ASSIGNMENT-KEY granularity, not the node granularity: two distinct
+# CALLDATA_WORD leaves whose 32-byte windows overlap mutate the same
+# underlying tx bytes, so they must share a cluster even though their
+# node ids differ.
+
+def _leaf_keys(tape: HostTape, leaves: List[int], cds_txs: frozenset) -> set:
+    """Assignment-granular variable keys touched by `leaves`. Calldata
+    words expand to their byte windows; when tx ``t``'s CALLDATASIZE is
+    constrained somewhere (``t in cds_txs``), every calldata read of tx
+    ``t`` couples to it (reads zero-pad past the chosen size, see
+    ``TxInput.read_word``). ORIGIN aliases CALLER(tx0) — the evaluator
+    defaults an unassigned origin to ``asn.caller`` — so ORIGIN leaves
+    carry the caller key too."""
+    from .eval import BY_NODE_KINDS, TX_STRIDE
+
+    keys = set()
+    for i in leaves:
+        nd = tape.nodes[i]
+        kind, b = nd.a, nd.b
+        if kind == int(FreeKind.CALLDATA_WORD):
+            tx, off = divmod(b, TX_STRIDE)
+            keys.update(("cd", tx, off + k) for k in range(32))
+            if tx in cds_txs:
+                keys.add((int(FreeKind.CALLDATASIZE), tx))
+        elif kind in BY_NODE_KINDS:
+            keys.add(("n", i))  # keyed by node id in Assignment.by_node
+        elif kind == int(FreeKind.ORIGIN):
+            keys.add((kind, b))
+            keys.add((int(FreeKind.CALLER), 0))  # default-aliases tx0 caller
+        else:
+            keys.add((kind, b))  # caller/callvalue/cds/env scalars
+    return keys
+
+
+def partition_constraints(tape: HostTape) -> List[List[int]]:
+    """Constraint indices grouped into independent clusters (union-find
+    over shared assignment keys). Constraints over no free variables are
+    singleton clusters — they evaluate concretely."""
+    n = len(tape.constraints)
+    if n <= 1:
+        return [list(range(n))] if n else []
+    supports = [_leaf_support(tape, node) for node, _ in tape.constraints]
+    # couple tx t's calldata reads to its CALLDATASIZE only when some
+    # constraint actually mentions THAT tx's cds: the tape pre-seeds an
+    # (unconstrained) cds node, and an unconstrained cds is never
+    # assigned by the search, so reads keep their default zero-padding
+    # regardless of cluster order
+    cds_txs = frozenset(
+        tape.nodes[i].b
+        for sup in supports for i in sup
+        if tape.nodes[i].a == int(FreeKind.CALLDATASIZE))
+    parent = list(range(n))
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    owner: Dict[tuple, int] = {}
+    for j in range(n):
+        for k in _leaf_keys(tape, supports[j], cds_txs):
+            if k in owner:
+                ra, rb = find(j), find(owner[k])
+                if ra != rb:
+                    parent[rb] = ra
+            else:
+                owner[k] = j
+    clusters: Dict[int, List[int]] = {}
+    for j in range(n):
+        clusters.setdefault(find(j), []).append(j)
+    return list(clusters.values())
+
+
+def _solve_partitioned(tape: HostTape, seed: int, max_iters: int,
+                       base: Optional[Assignment]
+                       ) -> Tuple[str, Optional[Assignment]]:
+    """Split the query into independent clusters and solve each with the
+    FULL search budget (smaller supports decide in far fewer iterations,
+    and a miss in one cluster can't thrash another's solved variables).
+    Clusters chain through one accumulating assignment — their key sets
+    are disjoint, so later solves cannot disturb earlier ones."""
+    clusters = partition_constraints(tape)
+    if len(clusters) <= 1:
+        out = _solve_tape_inner(tape, seed, max_iters, base)
+        return ("sat" if out is not None else "unknown"), out
+    SOLVER_STATS.partitioned += 1
+    asn = base.copy() if base is not None else Assignment()
+    for cl in clusters:
+        sub = HostTape(nodes=tape.nodes,
+                       constraints=[tape.constraints[j] for j in cl])
+        res = _solve_tape_inner(sub, seed, max_iters, base=asn)
+        if res is None:
+            # (a cluster over NO free variables can't reach here: a
+            # concretely-false closed constraint is proven unsat by
+            # refute_tape before partitioning runs)
+            return "unknown", None
+        asn = res
+    # safety net: the merged model must satisfy the WHOLE tape; a
+    # violation means a dependence the keys missed — fall back to the
+    # unpartitioned search rather than return a bogus model
+    vals = evaluate(tape, asn)
+    if all(bool(vals[n]) == s for n, s in tape.constraints):
+        return "sat", asn
+    out = _solve_tape_inner(tape, seed, max_iters, base)
+    return ("sat" if out is not None else "unknown"), out
 
 
 def _mutate_leaf(tape: HostTape, leaf: int, asn: Assignment, rng: random.Random):
@@ -289,8 +407,7 @@ def solve_tape_ex(tape: HostTape, seed: int = 0, max_iters: int = 400,
     if refute_tape(tape) is not None:
         verdict, out = "unsat", None
     else:
-        out = _solve_tape_inner(tape, seed, max_iters, base)
-        verdict = "sat" if out is not None else "unknown"
+        verdict, out = _solve_partitioned(tape, seed, max_iters, base)
     if key is not None:
         if len(_SOLVE_CACHE) >= _SOLVE_CACHE_CAP:
             _SOLVE_CACHE.pop(next(iter(_SOLVE_CACHE)))

@@ -31,7 +31,18 @@ class HostTape:
     pcs: List[int] = field(default_factory=list)  # branch pc per constraint (may be shorter)
 
 
-def intern_node(nodes: List[HostNode], node: HostNode) -> int:
+def node_index(nodes: List[HostNode]):
+    """Hash index for :func:`intern_node`: node -> FIRST id carrying it
+    (HostNode is frozen, hence hashable). Build once per tape, copy per
+    mutation batch — turns each intern from an O(n) dataclass-equality
+    scan into an O(1) lookup."""
+    idx = {}
+    for i, nd in enumerate(nodes):
+        idx.setdefault(nd, i)
+    return idx
+
+
+def intern_node(nodes: List[HostNode], node: HostNode, index=None) -> int:
     """Id of `node` in `nodes`, appending only when absent — the host
     analog of the device tape's hash-consing. Detection modules MUST
     build attack predicates through this: a predicate that re-creates a
@@ -39,7 +50,14 @@ def intern_node(nodes: List[HostNode], node: HostNode) -> int:
     branched on) then shares its id, so the refuter sees the polarity
     conflict and proves UNSAT instead of burning witness-search budget
     into an `unknown` (round 4: this was every second solver query on
-    the ERC-20 workload)."""
+    the ERC-20 workload). Pass the tape's :func:`node_index` when
+    interning repeatedly; it is kept in sync with appends."""
+    if index is not None:
+        hit = index.get(node)
+        if hit is None:
+            nodes.append(node)
+            hit = index[node] = len(nodes) - 1
+        return hit
     try:
         return nodes.index(node)
     except ValueError:

@@ -208,3 +208,100 @@ def test_solve_tape_memo_cache():
     assert solve_tape(tu) is None
     d = SOLVER_STATS.delta(before)
     assert d["unsat"] == 2 and d["cache_hits"] == 1, d
+
+
+# --- round-4 independence partitioning (reference: IndependenceSolver) ---
+
+def test_partition_independent_calldata_words():
+    from mythril_tpu.smt.tape import HostNode
+    from mythril_tpu.smt.solver import partition_constraints, solve_tape_ex
+    from mythril_tpu.symbolic.ops import SymOp, FreeKind
+    N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)
+    # word@4 == 0x1234  AND  word@36 == 7 — disjoint byte windows
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 4),    # 1
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 36),   # 2
+        N(SymOp.CONST, imm=0x1234),                       # 3
+        N(SymOp.CONST, imm=7),                            # 4
+        N(SymOp.EQ, 1, 3),                                # 5
+        N(SymOp.EQ, 2, 4),                                # 6
+    ]
+    t = _mk_tape(nodes, [(5, True), (6, True)])
+    assert len(partition_constraints(t)) == 2
+    from mythril_tpu.smt.solver import SOLVER_STATS, _SOLVE_CACHE
+    _SOLVE_CACHE.clear()
+    before = SOLVER_STATS.snapshot()
+    verdict, asn = solve_tape_ex(t)
+    assert verdict == "sat"
+    assert SOLVER_STATS.delta(before)["partitioned"] == 1
+    assert asn.read_calldata_word(4) == 0x1234
+    assert asn.read_calldata_word(36) == 7
+
+
+def test_partition_overlapping_windows_share_cluster():
+    from mythril_tpu.smt.tape import HostNode
+    from mythril_tpu.smt.solver import partition_constraints, solve_tape_ex
+    from mythril_tpu.symbolic.ops import SymOp, FreeKind
+    N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)
+    # word@0 and word@4 overlap in bytes [4, 32): solving them
+    # independently could clobber each other -> must be ONE cluster
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),    # 1
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 4),    # 2
+        N(SymOp.CONST, imm=1 << 128),                     # 3
+        N(SymOp.CONST, imm=99),                           # 4
+        N(SymOp.EQ, 1, 3),                                # 5
+        N(SymOp.EQ, 2, 4),                                # 6
+    ]
+    t = _mk_tape(nodes, [(5, True), (6, False)])
+    assert len(partition_constraints(t)) == 1
+    verdict, asn = solve_tape_ex(t)
+    assert verdict == "sat"
+    assert asn.read_calldata_word(0) == 1 << 128
+    assert asn.read_calldata_word(4) != 99
+
+
+def test_concrete_false_constraint_proves_unsat_before_partitioning():
+    from mythril_tpu.smt.tape import HostNode
+    from mythril_tpu.smt.solver import SOLVER_STATS, _SOLVE_CACHE, solve_tape_ex
+    from mythril_tpu.symbolic.ops import SymOp, FreeKind
+    N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)
+    # a solvable calldata constraint + a closed constraint that is
+    # concretely false: refute_tape proves unsat BEFORE the partitioner
+    # runs (so `partitioned` must not increment)
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),    # 1
+        N(SymOp.CONST, imm=3),                            # 2
+        N(SymOp.EQ, 1, 2),                                # 3: solvable
+        N(SymOp.CONST, imm=0),                            # 4
+        N(SymOp.CONST, imm=1),                            # 5
+        N(SymOp.EQ, 4, 5),                                # 6: 0 == 1
+    ]
+    t = _mk_tape(nodes, [(3, True), (6, True)])
+    _SOLVE_CACHE.clear()
+    before = SOLVER_STATS.snapshot()
+    verdict, asn = solve_tape_ex(t)
+    assert verdict == "unsat" and asn is None
+    assert SOLVER_STATS.delta(before)["partitioned"] == 0
+
+
+def test_partition_stats_and_erc20_path_still_solves():
+    from mythril_tpu.smt.solver import SOLVER_STATS, _SOLVE_CACHE
+
+    code = erc20_like()
+    sf, _ = explore(code)
+    act = np.asarray(sf.base.active)
+    wrote = np.asarray(sf.base.st_written).any(axis=1)
+    lane = int(np.where(act & wrote)[0][0])
+    _SOLVE_CACHE.clear()
+    before = SOLVER_STATS.snapshot()
+    asn = solve_lane(sf, lane)
+    assert asn is not None
+    assert bytes(asn.calldata[:4]) == bytes.fromhex("a9059cbb")
+    out = replay(code, asn)
+    assert bool(out.halted[0]) and not bool(out.error[0])
+    d = SOLVER_STATS.delta(before)
+    assert d["sat"] >= 1
