@@ -80,6 +80,10 @@ CLASS_TABLE = _build_class_table()
 # jnp views of the metadata tables (built once at import)
 _J_STACK_IN = jnp.asarray(oc.STACK_IN)
 _J_STACK_OUT = jnp.asarray(oc.STACK_OUT)
+# every EVM op with sout > 0 rewrites the post-op top of stack; the
+# shared writeback lands it at sp - sin + sout - 1 (pre-step sp)
+_J_PUSHES = jnp.asarray(oc.STACK_OUT > 0)
+_J_D_SP = jnp.asarray(oc.STACK_OUT - oc.STACK_IN)
 _J_GAS_MIN = jnp.asarray(oc.GAS_MIN)
 _J_GAS_MAX = jnp.asarray(oc.GAS_MAX)
 _J_GAS_MIN_BERLIN = jnp.asarray(oc.GAS_MIN_BERLIN)
@@ -232,7 +236,17 @@ def _charge(f: Frontier, mask, amount) -> Frontier:
 
 
 # ---------------------------------------------------------------------------
-# Class handlers — each: (f, env, corpus, op, mask, old_pc) -> f
+# Class handlers — each: (f, env, corpus, op, mask, old_pc) -> (f, aux)
+#
+# Handlers DO NOT write ``stack`` or ``sp``. A value-producing class
+# returns its result word in ``aux["r"]`` (u32[P,8]) and the shared
+# writeback in ``dispatch`` lands it at ``sp - sin + sout - 1`` once per
+# superstep; ``sp`` advances centrally by the STACK_OUT-STACK_IN table.
+# This keeps the 16 per-class ``lax.cond`` boundaries free of the [P,S,8]
+# stack array — the round-4 profile showed the untaken conds' stack
+# copies dominating the superstep. Optional aux keys: ``ok`` (bool[P],
+# vetoes the write for lanes that trapped mid-handler) and the SWAP
+# second write port ``w2_idx``/``w2_val``/``w2_mask``.
 # ---------------------------------------------------------------------------
 
 
@@ -264,30 +278,32 @@ def _h_stack(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     msize_val = u256.from_u64_scalar((f.mem_words.astype(jnp.uint64)) * 32)
     gas_val = u256.from_u64_scalar(jnp.maximum(f.gas_limit - f.gas_max, 0).astype(jnp.uint64))
 
+    # SWAP n: top goes to slot n below top via the second write port;
+    # the slot-(n) value lands at the post-op top (sp-1) via `r` — the
+    # shared writeback's sp - sin + sout - 1 is exactly sp-1 for SWAPs.
+    swap_n = jnp.where(is_swap, op.astype(I32) - 0x8F, 1)
+    top = _peek(f, 0)
+    deep = _peek(f, swap_n)
+
     val = jnp.where(
         is_push[:, None], push_val,
         jnp.where(is_dup[:, None], dup_val,
                   jnp.where((op == 0x58)[:, None], pc_val,
-                            jnp.where((op == 0x59)[:, None], msize_val, gas_val))))
-    does_push = is_push | is_dup | (op == 0x58) | (op == 0x59) | (op == 0x5A)
-    stack = _set_slot(f.stack, f.sp, val, m & does_push)
-
-    # SWAP n: exchange top with slot n below top
-    swap_n = jnp.where(is_swap, op.astype(I32) - 0x8F, 1)
-    top = _peek(f, 0)
-    deep = _peek(f, swap_n)
-    stack = _set_slot(stack, f.sp - 1, deep, m & is_swap)
-    stack = _set_slot(stack, f.sp - 1 - swap_n, top, m & is_swap)
-
-    d_sp = _J_STACK_OUT[op] - _J_STACK_IN[op]
-    sp = jnp.where(m, f.sp + d_sp, f.sp)
-    return f.replace(stack=stack, sp=sp)
+                            jnp.where((op == 0x59)[:, None], msize_val,
+                                      jnp.where(is_swap[:, None], deep,
+                                                gas_val)))))
+    # POP/JUMPDEST have sout == 0, so _J_PUSHES masks their write off
+    return f, {
+        "r": val,
+        "w2_idx": f.sp - 1 - swap_n,
+        "w2_val": top,
+        "w2_mask": m & is_swap,
+    }
 
 
 def _h_alu(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     a = _peek(f, 0)
     b = _peek(f, 1)
-    is_unary = (op == 0x15) | (op == 0x19)  # ISZERO NOT
 
     r = u256.add(a, b)
     r = jnp.where((op == 0x03)[:, None], u256.sub(a, b), r)
@@ -306,17 +322,11 @@ def _h_alu(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     r = jnp.where((op == 0x1B)[:, None], u256.shl(a, b), r)
     r = jnp.where((op == 0x1C)[:, None], u256.shr(a, b), r)
     r = jnp.where((op == 0x1D)[:, None], u256.sar(a, b), r)
-
-    dest = jnp.where(is_unary, f.sp - 1, f.sp - 2)
-    stack = _set_slot(f.stack, dest, r, m)
-    sp = jnp.where(m & ~is_unary, f.sp - 1, f.sp)
-    return f.replace(stack=stack, sp=sp)
+    return f, {"r": r}
 
 
 def _h_mul(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
-    r = u256.mul(_peek(f, 0), _peek(f, 1))
-    stack = _set_slot(f.stack, f.sp - 2, r, m)
-    return f.replace(stack=stack, sp=jnp.where(m, f.sp - 1, f.sp))
+    return f, {"r": u256.mul(_peek(f, 0), _peek(f, 1))}
 
 
 def _h_divmod(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -337,8 +347,7 @@ def _h_divmod(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
         jnp.where(signed[:, None], rem_signed, rem),
     )
     r = jnp.where(bz, 0, r).astype(U32)
-    stack = _set_slot(f.stack, f.sp - 2, r, m)
-    return f.replace(stack=stack, sp=jnp.where(m, f.sp - 1, f.sp))
+    return f, {"r": r}
 
 
 def _h_modarith(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -351,14 +360,12 @@ def _h_modarith(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     )
     wide = jnp.where(is_add[:, None], wide_add, wide_mul)
     r = u256._mod_wide(wide, n)
-    stack = _set_slot(f.stack, f.sp - 3, r, m)
-    return f.replace(stack=stack, sp=jnp.where(m, f.sp - 2, f.sp))
+    return f, {"r": r}
 
 
 def _h_exp(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     base, e = _peek(f, 0), _peek(f, 1)
     r = u256.exp(base, e)
-    stack = _set_slot(f.stack, f.sp - 2, r, m)
     # dynamic gas: 50 per significant exponent byte
     e_bytes = _word_to_be_bytes(e)
     nz = e_bytes != 0
@@ -366,7 +373,7 @@ def _h_exp(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     any_nz = jnp.any(nz, axis=1)
     n_bytes = jnp.where(any_nz, 32 - first_nz, 0).astype(I64)
     f = _charge(f, m, 50 * n_bytes)
-    return f.replace(stack=stack, sp=jnp.where(m, f.sp - 1, f.sp))
+    return f, {"r": r}
 
 
 MAX_HASH_BYTES = 200  # SHA3 input cap (mapping keys need 64; see LimitsConfig)
@@ -384,13 +391,9 @@ def _h_sha3(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     # zero bytes past ln
     data = jnp.where(jnp.arange(max_hash)[None, :] < ln[:, None], data, 0)
     digest = keccak256_device(data, jnp.clip(ln, 0, max_hash).astype(I32))
-    stack = _set_slot(f.stack, f.sp - 2, digest, ok)
     words = (ln + 31) // 32
     f = _charge(f, ok, 6 * words)
-    return f.replace(
-        stack=stack,
-        sp=jnp.where(m, f.sp - 1, f.sp),
-    ).trap(too_long, Trap.HASH_LIMIT)
+    return f.trap(too_long, Trap.HASH_LIMIT), {"r": digest, "ok": ok}
 
 
 def _h_env(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -446,12 +449,7 @@ def _h_env(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     r = jnp.where((op == 0x46)[:, None], env.chainid, r)
     r = jnp.where((op == 0x47)[:, None], f.self_balance, r)
     r = jnp.where((op == 0x48)[:, None], env.basefee, r)
-
-    sin = _J_STACK_IN[op]
-    dest = jnp.where(sin == 1, f.sp - 1, f.sp)
-    stack = _set_slot(f.stack, dest, r, m)
-    sp = jnp.where(m & (sin == 0), f.sp + 1, f.sp)
-    return f.replace(stack=stack, sp=sp)
+    return f, {"r": r}
 
 
 def _h_copy(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -502,8 +500,7 @@ def _h_copy(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     memory = jnp.where(in_window & ok[:, None], srcb, f.memory)
     words = (ln64 + 31) // 32
     f = _charge(f, ok, 3 * words)
-    d_sp = _J_STACK_IN[op]
-    return f.replace(memory=memory.astype(U8), sp=jnp.where(m, f.sp - d_sp, f.sp))
+    return f.replace(memory=memory.astype(U8)), {}
 
 
 def _take_per_lane(buf, idx, limit):
@@ -528,16 +525,13 @@ def _h_mem(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     loaded = _be_bytes_to_word(
         _gather_bytes(f.memory, off, 32, jnp.full_like(off, f.memory.shape[1]))
     )
-    stack = _set_slot(f.stack, f.sp - 1, loaded, ok & is_load)
 
     # MSTORE / MSTORE8
     bytes32 = _word_to_be_bytes(val)
     mem = _scatter_bytes(f.memory, off, bytes32, 32, ok & (op == 0x52))
     low_byte = (val[:, 0] & U32(0xFF)).astype(U8)[:, None]
     mem = _scatter_bytes(mem, off, low_byte, 1, ok & is_store8)
-
-    sp = jnp.where(m & ~is_load, f.sp - 2, f.sp)
-    return f.replace(stack=stack, memory=mem, sp=sp)
+    return f.replace(memory=mem), {"r": loaded, "ok": ok}
 
 
 def _storage_lookup(f: Frontier, key):
@@ -598,7 +592,6 @@ def _h_storage(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
 
     # SLOAD: miss -> 0 (clean storage; unconstrained/world storage in sym layer)
     loaded = jnp.where(hit[:, None], cur, 0).astype(U32)
-    stack = _set_slot(f.stack, f.sp - 1, loaded, m & ~is_store)
 
     widx, overflow = storage_alloc(f, hit, slot, m & is_store)
     st_keys = _write_slot(f.st_keys, widx, key)
@@ -607,11 +600,12 @@ def _h_storage(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     st_written = _write_slot(f.st_written, widx, True)
     st_acct = _write_slot(f.st_acct, widx, f.cur_acct)
 
-    sp = jnp.where(m & is_store, f.sp - 2, f.sp)
     return f.replace(
-        stack=stack, sp=sp, st_keys=st_keys, st_vals=st_vals,
+        st_keys=st_keys, st_vals=st_vals,
         st_used=st_used, st_written=st_written, st_acct=st_acct,
-    ).trap(overflow, Trap.STORAGE_SLOTS).trap(static_viol, Trap.STATIC_WRITE)
+    ).trap(overflow, Trap.STORAGE_SLOTS).trap(static_viol, Trap.STATIC_WRITE), {
+        "r": loaded, "ok": m & ~is_store,
+    }
 
 
 def _h_jump(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -623,8 +617,7 @@ def _h_jump(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     bad = m & taken & ~valid_dest
     new_pc = jnp.where(taken, dest.astype(I32), old_pc + 1)
     pc = jnp.where(m & ~bad, new_pc, f.pc)
-    d_sp = jnp.where(is_jumpi, 2, 1)
-    return f.replace(pc=pc, sp=jnp.where(m, f.sp - d_sp, f.sp)).trap(bad, Trap.BAD_JUMP)
+    return f.replace(pc=pc).trap(bad, Trap.BAD_JUMP), {}
 
 
 def _h_halt(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -661,8 +654,7 @@ def _h_halt(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
         retval_len=retval_len,
         gas_min=gas_min,
         gas_max=gas_max,
-        sp=jnp.where(m, f.sp - _J_STACK_IN[op], f.sp),
-    )
+    ), {}
 
 
 def _h_log(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
@@ -692,35 +684,27 @@ def _h_log(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
             f.log_topic0, widx,
             jnp.where((n_topics >= 1)[:, None], topic0, 0).astype(U32)),
         log_data0=_write_slot(f.log_data0, widx, data0),
-        sp=jnp.where(m, f.sp - _J_STACK_IN[op], f.sp),
-    ).trap(static_viol, Trap.STATIC_WRITE)
+    ).trap(static_viol, Trap.STATIC_WRITE), {}
 
 
 def _h_call(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     """CALL family stub: success=1, empty returndata. Real sub-transactions
     are orchestrated by the symbolic VM layer (reference: call_ raising
     TransactionStartSignal ⚠unv)."""
-    sin = _J_STACK_IN[op]
     one = jnp.zeros_like(_peek(f, 0)).at[:, 0].set(1)
-    dest = f.sp - sin
-    stack = _set_slot(f.stack, dest, one, m)
     return f.replace(
-        stack=stack,
-        sp=jnp.where(m, f.sp - sin + 1, f.sp),
         returndata_len=jnp.where(m, 0, f.returndata_len),
-    )
+    ), {"r": one}
 
 
 def _h_create(f: Frontier, env: Env, corpus: Corpus, op, m, old_pc):
     """CREATE/CREATE2 stub: pushes zero address (creation semantics live in
     the tx layer)."""
-    sin = _J_STACK_IN[op]
     zero = jnp.zeros_like(_peek(f, 0))
     off = u256.to_u64_saturating(_peek(f, 1)).astype(I64)
     ln = u256.to_u64_saturating(_peek(f, 2)).astype(I64)
     f, _ = _expand_memory(f, m & (ln > 0), off + ln)
-    stack = _set_slot(f.stack, f.sp - sin, zero, m)
-    return f.replace(stack=stack, sp=jnp.where(m, f.sp - sin + 1, f.sp))
+    return f, {"r": zero}
 
 
 _HANDLERS = [
@@ -804,37 +788,61 @@ def default_cond_classes() -> tuple:
 
 
 # Fields each class handler may WRITE. A gated class's `lax.cond`
-# returns ONLY these leaves — the other ~200 MB of frontier never become
-# cond outputs, so XLA cannot be forced to materialize them at the
-# boundary (measured: the narrow outputs are what make 16 sequential
-# conds affordable on TPU). The declaration is enforced at trace time:
-# an undeclared write raises AssertionError during the first jit.
+# returns ONLY these leaves — the rest of the frontier never becomes a
+# cond output, so XLA cannot be forced to materialize it at the
+# boundary. NOTE `stack` and `sp` appear in NO write set: handlers
+# return result words through the aux channel and the shared writeback
+# below touches the [P,S,8] stack exactly once per superstep (round 4:
+# with stack in ten classes' write sets, the untaken conds' stack
+# copies were ~85% of superstep traffic and scaled superlinearly with
+# P). The declaration is enforced at trace time: an undeclared write
+# raises AssertionError during the first jit.
 WRITE_FIELDS = {
-    CLS_STACK: ("stack", "sp"),
-    CLS_ALU: ("stack", "sp"),
-    CLS_MUL: ("stack", "sp"),
-    CLS_DIVMOD: ("stack", "sp"),
-    CLS_MODARITH: ("stack", "sp"),
-    CLS_EXP: ("stack", "sp", "gas_min", "gas_max"),
-    CLS_SHA3: ("stack", "sp", "gas_min", "gas_max", "mem_words",
+    CLS_STACK: (),
+    CLS_ALU: (),
+    CLS_MUL: (),
+    CLS_DIVMOD: (),
+    CLS_MODARITH: (),
+    CLS_EXP: ("gas_min", "gas_max"),
+    CLS_SHA3: ("gas_min", "gas_max", "mem_words", "error", "err_code"),
+    CLS_ENV: (),
+    CLS_COPY: ("memory", "gas_min", "gas_max", "mem_words",
                "error", "err_code"),
-    CLS_ENV: ("stack", "sp"),
-    CLS_COPY: ("memory", "sp", "gas_min", "gas_max", "mem_words",
-               "error", "err_code"),
-    CLS_MEM: ("stack", "memory", "sp", "gas_min", "gas_max", "mem_words",
+    CLS_MEM: ("memory", "gas_min", "gas_max", "mem_words",
               "error", "err_code"),
-    CLS_STORAGE: ("stack", "sp", "st_keys", "st_vals", "st_used",
+    CLS_STORAGE: ("st_keys", "st_vals", "st_used",
                   "st_written", "st_acct", "error", "err_code"),
-    CLS_JUMP: ("pc", "sp", "error", "err_code"),
+    CLS_JUMP: ("pc", "error", "err_code"),
     CLS_HALT: ("halted", "reverted", "selfdestructed", "retval",
-               "retval_len", "gas_min", "gas_max", "mem_words", "sp",
+               "retval_len", "gas_min", "gas_max", "mem_words",
                "error", "err_code"),
     CLS_LOG: ("n_logs", "log_pc", "log_cid", "log_ntopics", "log_topic0",
-              "log_data0", "sp", "gas_min", "gas_max", "mem_words",
+              "log_data0", "gas_min", "gas_max", "mem_words",
               "error", "err_code"),
-    CLS_CALL: ("stack", "sp", "returndata_len"),
-    CLS_CREATE: ("stack", "sp", "gas_min", "gas_max", "mem_words",
-                 "error", "err_code"),
+    CLS_CALL: ("returndata_len",),
+    CLS_CREATE: ("gas_min", "gas_max", "mem_words", "error", "err_code"),
+}
+
+# Aux outputs each class hands to the shared writeback: "r" the result
+# word (u32[P,8]), "ok" a per-lane write veto (lanes that trapped inside
+# the handler), and STACK's SWAP second write port.
+AUX_KEYS = {
+    CLS_STACK: ("r", "w2_idx", "w2_val", "w2_mask"),
+    CLS_ALU: ("r",),
+    CLS_MUL: ("r",),
+    CLS_DIVMOD: ("r",),
+    CLS_MODARITH: ("r",),
+    CLS_EXP: ("r",),
+    CLS_SHA3: ("r", "ok"),
+    CLS_ENV: ("r",),
+    CLS_COPY: (),
+    CLS_MEM: ("r", "ok"),
+    CLS_STORAGE: ("r", "ok"),
+    CLS_JUMP: (),
+    CLS_HALT: (),
+    CLS_LOG: (),
+    CLS_CALL: ("r",),
+    CLS_CREATE: ("r",),
 }
 
 _FRONTIER_FIELDS: Tuple[str, ...] = ()
@@ -857,7 +865,7 @@ def _key_name(k) -> str:
     return str(k)
 
 
-def narrow_cond(pred, fn, obj, declared):
+def narrow_cond(pred, fn, obj, declared, aux_defaults=None):
     """``lax.cond(pred, fn, identity, obj)`` whose cond OUTPUTS are only
     the leaves under the ``declared`` dotted field paths — the rest of the
     pytree bypasses the cond entirely, so XLA cannot be forced to
@@ -865,7 +873,14 @@ def narrow_cond(pred, fn, obj, declared):
     ``dispatch``'s WRITE_FIELDS, generalized to nested pytrees like
     SymFrontier where writes land both on ``base.stack`` and on overlay
     fields). ``fn`` must write ONLY under ``declared``; an undeclared
-    write raises at first trace."""
+    write raises at first trace.
+
+    With ``aux_defaults`` (an ordered dict of default arrays), ``fn``
+    returns ``(new_obj, aux_dict)`` and this returns ``(obj, aux)`` —
+    the aux arrays ride the cond boundary (defaults when untaken), which
+    is how a claimed handler hands a result word to a shared writeback
+    without putting the whole stack in its write set (cf. dispatch's
+    AUX_KEYS)."""
     import jax.tree_util as jtu
 
     kl, treedef = jtu.tree_flatten_with_path(obj)
@@ -875,31 +890,51 @@ def narrow_cond(pred, fn, obj, declared):
         return any(n == d or n.startswith(d + ".") for d in declared)
 
     idxs = [i for i, n in enumerate(names) if is_declared(n)]
+    akeys = tuple(aux_defaults) if aux_defaults else ()
 
     def _true():
-        new = fn(obj)
+        if aux_defaults is None:
+            new, aux = fn(obj), {}
+        else:
+            new, aux = fn(obj)
+            for k in aux:
+                if k not in akeys:
+                    raise AssertionError(
+                        f"{getattr(fn, '__name__', fn)} returned undeclared "
+                        f"aux {k!r}; add it to aux_defaults")
         new_kl, _ = jtu.tree_flatten_with_path(new)
         for (_, b), (_, a), n in zip(new_kl, kl, names):
             if b is not a and not is_declared(n):
                 raise AssertionError(
                     f"{getattr(fn, '__name__', fn)} wrote undeclared leaf "
                     f"{n!r}; add it to the declared write set")
-        return tuple(new_kl[i][1] for i in idxs)
+        return tuple(new_kl[i][1] for i in idxs) + tuple(
+            aux.get(k, aux_defaults[k]) for k in akeys)
 
     def _false():
-        return tuple(kl[i][1] for i in idxs)
+        return tuple(kl[i][1] for i in idxs) + tuple(
+            aux_defaults[k] for k in akeys)
 
     outs = lax.cond(pred, _true, _false)
     leaves = [leaf for _, leaf in kl]
     for j, i in enumerate(idxs):
         leaves[i] = outs[j]
-    return jtu.tree_unflatten(treedef, leaves)
+    out_obj = jtu.tree_unflatten(treedef, leaves)
+    if aux_defaults is None:
+        return out_obj
+    return out_obj, dict(zip(akeys, outs[len(idxs):]))
 
 
 def dispatch(f: Frontier, env: Env, corpus: Corpus, op, run, old_pc,
              skip=None, cond_classes=None) -> Frontier:
     """Run the per-class handlers over the frontier. ``skip`` masks lanes
-    out of concrete handling (the symbolic engine claims them)."""
+    out of concrete handling (the symbolic engine claims them).
+
+    Handlers return ``(frontier, aux)``; the stack is written HERE, once:
+    each value class's result word rides the aux channel through its
+    (narrow) cond boundary, and one shared ``_set_slot`` pass lands every
+    class's result at ``sp - sin + sout - 1`` (plus the SWAP second
+    port). ``sp`` advances centrally from the arity tables."""
     if cond_classes is None:
         cond_classes = default_cond_classes()
     cls = _J_CLASS[op]
@@ -914,30 +949,77 @@ def dispatch(f: Frontier, env: Env, corpus: Corpus, op, run, old_pc,
         (cls[:, None] == jnp.arange(N_CLASSES, dtype=cls.dtype)[None, :])
         & run[:, None], axis=0)
     all_fields = _frontier_fields(f)
+    P = f.pc.shape[0]
+    zero_word = jnp.zeros((P, 8), dtype=U32)
+    aux_defaults = {
+        "r": zero_word,
+        "ok": jnp.zeros(P, dtype=bool),
+        "w2_idx": jnp.zeros(P, dtype=I32),
+        "w2_val": zero_word,
+        "w2_mask": jnp.zeros(P, dtype=bool),
+    }
+    pre_sp = f.sp
+    val = zero_word
+    veto = jnp.zeros(P, dtype=bool)
+    w2_idx = aux_defaults["w2_idx"]
+    w2_val = zero_word
+    w2_mask = aux_defaults["w2_mask"]
     for cid, handler in enumerate(_HANDLERS):
         mask = run & (cls == cid)
+        names = WRITE_FIELDS[cid]
+        akeys = AUX_KEYS[cid]
         if cid in cond_classes:
-            names = WRITE_FIELDS[cid]
 
-            def _run_handler(fr=f, h=handler, mk=mask, names=names):
-                fr2 = h(fr, env, corpus, op, mk, old_pc)
+            def _run_handler(fr=f, h=handler, mk=mask, names=names,
+                             akeys=akeys):
+                fr2, aux = h(fr, env, corpus, op, mk, old_pc)
                 for fld in all_fields:
                     if fld not in names and \
                             getattr(fr2, fld) is not getattr(fr, fld):
                         raise AssertionError(
                             f"{h.__name__} wrote undeclared field {fld!r}; "
                             f"add it to WRITE_FIELDS[{cid}]")
-                return tuple(getattr(fr2, n) for n in names)
+                for k in aux:
+                    if k not in akeys:
+                        raise AssertionError(
+                            f"{h.__name__} returned undeclared aux {k!r}; "
+                            f"add it to AUX_KEYS[{cid}]")
+                return tuple(getattr(fr2, n) for n in names) + tuple(
+                    aux.get(k, aux_defaults[k]) for k in akeys)
 
             outs = lax.cond(
                 present[cid],
                 _run_handler,
-                lambda fr=f, names=names: tuple(getattr(fr, n) for n in names),
+                lambda fr=f, names=names, akeys=akeys: tuple(
+                    getattr(fr, n) for n in names) + tuple(
+                    aux_defaults[k] for k in akeys),
             )
-            f = f.replace(**dict(zip(names, outs)))
+            f = f.replace(**dict(zip(names, outs[:len(names)])))
+            aux = dict(zip(akeys, outs[len(names):]))
         else:
-            f = handler(f, env, corpus, op, mask, old_pc)
-    return f
+            f2, aux = handler(f, env, corpus, op, mask, old_pc)
+            for fld in all_fields:
+                if fld not in names and \
+                        getattr(f2, fld) is not getattr(f, fld):
+                    raise AssertionError(
+                        f"{handler.__name__} wrote undeclared field {fld!r}; "
+                        f"add it to WRITE_FIELDS[{cid}]")
+            f = f2
+        if "r" in akeys:
+            val = jnp.where(mask[:, None], aux.get("r", zero_word), val)
+        if "ok" in akeys:
+            veto = veto | (mask & ~aux.get("ok", aux_defaults["ok"]))
+        if "w2_mask" in akeys:
+            w2_idx = aux.get("w2_idx", aux_defaults["w2_idx"])
+            w2_val = aux.get("w2_val", zero_word)
+            w2_mask = aux.get("w2_mask", aux_defaults["w2_mask"])
+    # shared writeback: ONE stack pass for every value class + SWAP port
+    w1_mask = run & _J_PUSHES[op] & ~veto
+    w1_idx = pre_sp - _J_STACK_IN[op] + _J_STACK_OUT[op] - 1
+    stack = _set_slot(f.stack, w1_idx, val, w1_mask)
+    stack = _set_slot(stack, w2_idx, w2_val, w2_mask)
+    sp = jnp.where(run, pre_sp + _J_D_SP[op], pre_sp)
+    return f.replace(stack=stack, sp=sp)
 
 
 def epilogue(f: Frontier, op, run, old_pc) -> Frontier:

@@ -234,8 +234,6 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     f = sf.base
     loaded = jnp.where(hit[:, None], cur, 0).astype(U32)
     loaded_sym = jnp.where(hit, cur_sym, leaf)
-    stack = ci._set_slot(f.stack, f.sp - 1, loaded, m & ~is_store)
-    stack_sym = _set_sym_slot(sf.stack_sym, f.sp - 1, loaded_sym, m & ~is_store)
 
     # SSTORE into matching-or-free slot (shared alloc policy with the
     # concrete handler)
@@ -254,17 +252,19 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     )[:, 0]
     key_is_hash = key_op == int(SymOp.KECCAK)
     first_arb = store_m & (key_sym != 0) & ~key_is_hash & (sf.arb_key_pc < 0)
+    # SLOAD results ride the aux channel to sym_superstep's shared
+    # writeback — base.stack/base.sp/stack_sym stay OUT of this claimed
+    # handler's cond outputs (same traffic argument as dispatch's
+    # WRITE_FIELDS: an untaken/taken cond otherwise materializes the
+    # whole [P,S,8] stack at the boundary every storage superstep)
     return sf.replace(
         base=f.replace(
-            stack=stack,
-            sp=jnp.where(m & is_store, f.sp - 2, f.sp),
             st_keys=ci._write_slot(f.st_keys, widx, key),
             st_vals=ci._write_slot(f.st_vals, widx, val),
             st_used=ci._write_slot(f.st_used, widx, True),
             st_written=ci._write_slot(f.st_written, widx, True),
             st_acct=ci._write_slot(f.st_acct, widx, f.cur_acct),
         ).trap(overflow, Trap.STORAGE_SLOTS),
-        stack_sym=stack_sym,
         st_key_sym=ci._write_slot(sf.st_key_sym, widx, key_sym),
         st_val_sym=ci._write_slot(sf.st_val_sym, widx, val_sym),
         sstore_after_call_pc=jnp.where(first_after_call, f.pc, sf.sstore_after_call_pc),
@@ -272,7 +272,7 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
         arb_key_node=jnp.where(first_arb, key_sym, sf.arb_key_node),
         arb_key_pc=jnp.where(first_arb, f.pc, sf.arb_key_pc),
         arb_key_cid=jnp.where(first_arb, f.contract_id, sf.arb_key_cid),
-    )
+    ), {"r": loaded, "r_sym": loaded_sym, "w": m & ~is_store}
 
 
 def _h_sym_jump(sf: SymFrontier, corpus: Corpus, op, m, old_pc, known, ksign) -> SymFrontier:
@@ -2085,9 +2085,9 @@ def _berlin_gas_post(sf: SymFrontier, op, run, key_w, key_s) -> SymFrontier:
 _TAPE_WRITES = ("tape_op", "tape_a", "tape_b", "tape_imm", "tape_hash",
                 "tape_len")
 _STORAGE_WRITES = (
-    "base.stack", "base.sp", "base.st_keys", "base.st_vals", "base.st_used",
+    "base.st_keys", "base.st_vals", "base.st_used",
     "base.st_written", "base.st_acct", "base.error", "base.err_code",
-    "stack_sym", "st_key_sym", "st_val_sym", "dep_read",
+    "st_key_sym", "st_val_sym", "dep_read",
     "sstore_after_call_pc", "sstore_ac_cid", "arb_key_node", "arb_key_pc",
     "arb_key_cid",
 ) + _TAPE_WRITES
@@ -2156,9 +2156,28 @@ def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
     # rest of the SymFrontier (frame stacks, memory, calldata overlays)
     # out of the boundary. CALL/CREATE write half the frontier and fire
     # rarely — they keep the plain full-state cond.
-    sf = ci.narrow_cond(jnp.any(claim_storage),
-                        lambda x: _h_sym_storage(x, spec, op, claim_storage),
-                        sf, _STORAGE_WRITES)
+    P = sf.base.pc.shape[0]
+    sf, st_aux = ci.narrow_cond(
+        jnp.any(claim_storage),
+        lambda x: _h_sym_storage(x, spec, op, claim_storage),
+        sf, _STORAGE_WRITES,
+        aux_defaults={
+            "r": jnp.zeros((P, 8), dtype=jnp.uint32),
+            "r_sym": jnp.zeros(P, dtype=I32),
+            "w": jnp.zeros(P, dtype=bool),
+        })
+    # shared claimed writeback: the SLOAD result lands here, and sp for
+    # ALL storage-claimed lanes advances by the arity table (SLOAD 0,
+    # SSTORE -2) — one stack pass instead of a stack-carrying cond
+    fb = sf.base
+    sf = sf.replace(
+        base=fb.replace(
+            stack=ci._set_slot(fb.stack, fb.sp - 1, st_aux["r"], st_aux["w"]),
+            sp=jnp.where(claim_storage, fb.sp + ci._J_D_SP[op], fb.sp),
+        ),
+        stack_sym=_set_sym_slot(sf.stack_sym, fb.sp - 1, st_aux["r_sym"],
+                                st_aux["w"]),
+    )
     sf = ci.narrow_cond(
         jnp.any(claim_jump),
         lambda x: _h_sym_jump(x, corpus, op, claim_jump, old_pc, known,
