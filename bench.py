@@ -194,6 +194,10 @@ def bench_profile(timeout_s: float = 600.0) -> dict:
     env.setdefault("PROF_P", str(P))
     env.setdefault("PROF_STEPS", str(MAX_STEPS))
     env.setdefault("PROF_REPS", "5")
+    # ONE variant: the profiler's own default sweeps 4 dispatch variants
+    # = 4 large XLA programs, which a cold cache through the axon tunnel
+    # cannot compile inside the driver's budget (round 4: >15 min EACH)
+    env.setdefault("PROF_VARIANTS", "all_cond")
     r = subprocess.run(
         [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                       "tools", "profile_superstep.py")],

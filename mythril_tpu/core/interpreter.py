@@ -1004,6 +1004,11 @@ def dispatch(f: Frontier, env: Env, corpus: Corpus, op, run, old_pc,
                     raise AssertionError(
                         f"{handler.__name__} wrote undeclared field {fld!r}; "
                         f"add it to WRITE_FIELDS[{cid}]")
+            for k in aux:
+                if k not in akeys:
+                    raise AssertionError(
+                        f"{handler.__name__} returned undeclared aux {k!r}; "
+                        f"add it to AUX_KEYS[{cid}]")
             f = f2
         if "r" in akeys:
             val = jnp.where(mask[:, None], aux.get("r", zero_word), val)

@@ -116,3 +116,91 @@ def test_write_slot_scalar_and_bool():
         jnp.asarray(arr), jnp.asarray(idx), True))
     want = ref_write(arr, idx, True)
     assert (a == b).all() and (a == want).all()
+
+
+def test_narrow_cond_aux_defaults_and_taken():
+    """narrow_cond's aux channel: defaults when the cond is untaken,
+    handler values when taken (the mechanism the shared stack writeback
+    rides — dispatch AUX_KEYS / sym claimed storage)."""
+    from mythril_tpu.config import TEST_LIMITS
+    from mythril_tpu.core import make_frontier
+
+    f = make_frontier(4, TEST_LIMITS)
+    defaults = {"r": jnp.zeros((4, 8), dtype=jnp.uint32),
+                "w": jnp.zeros(4, dtype=bool)}
+
+    def handler(fr):
+        return fr.replace(pc=fr.pc + 1), {
+            "r": jnp.ones((4, 8), dtype=jnp.uint32),
+            "w": jnp.ones(4, dtype=bool),
+        }
+
+    taken, aux_t = ci.narrow_cond(jnp.bool_(True), handler, f,
+                                  ("pc",), aux_defaults=defaults)
+    untaken, aux_f = ci.narrow_cond(jnp.bool_(False), handler, f,
+                                    ("pc",), aux_defaults=defaults)
+    assert np.asarray(taken.pc).tolist() == (np.asarray(f.pc) + 1).tolist()
+    assert np.asarray(untaken.pc).tolist() == np.asarray(f.pc).tolist()
+    assert bool(np.asarray(aux_t["w"]).all())
+    assert not bool(np.asarray(aux_f["w"]).any())
+    assert np.asarray(aux_t["r"]).max() == 1
+    assert np.asarray(aux_f["r"]).max() == 0
+
+
+def test_narrow_cond_undeclared_aux_raises():
+    from mythril_tpu.config import TEST_LIMITS
+    from mythril_tpu.core import make_frontier
+
+    f = make_frontier(2, TEST_LIMITS)
+
+    def handler(fr):
+        return fr, {"bogus": jnp.zeros(2)}
+
+    try:
+        ci.narrow_cond(jnp.bool_(True), handler, f, (),
+                       aux_defaults={"r": jnp.zeros(2)})
+    except AssertionError as e:
+        assert "undeclared aux" in str(e)
+    else:
+        raise AssertionError("undeclared aux key must raise at trace time")
+
+
+def test_shared_writeback_swap_and_veto_semantics():
+    """SWAP16-at-depth and the ok-veto: the dispatch shared writeback must
+    reproduce the per-handler writes the oracle suites pin, including the
+    second write port and a vetoed MLOAD (oob) leaving the stack slot
+    untouched."""
+    from mythril_tpu.config import TEST_LIMITS
+    from mythril_tpu.core import Corpus, make_env, make_frontier, run
+    from mythril_tpu.disassembler import ContractImage
+    from mythril_tpu.disassembler.asm import assemble
+
+    # push 17 distinct values, SWAP16, store top and the swapped-to slot
+    prog = []
+    for k in range(17):
+        prog.append(("push1", k + 1))
+    prog += ["SWAP16",
+             ("push1", 0), "MSTORE",            # writes top (was slot 16)
+             ("push1", 0), ("push1", 0), "RETURN"]
+    code = assemble(*prog)
+    img = ContractImage.from_bytecode(code, TEST_LIMITS.max_code)
+    corpus = Corpus.from_images([img])
+    f = make_frontier(2, TEST_LIMITS)
+    out = run(f, make_env(2), corpus, max_steps=64)
+    assert bool(out.halted[0]) and not bool(out.error[0])
+    # after SWAP16 the top is the value pushed FIRST (1); MSTORE@0 wrote it
+    mem0 = np.asarray(out.memory)[0, :32]
+    assert int(mem0[31]) == 1 and int(mem0[:31].sum()) == 0
+
+    # veto: MLOAD at an offset past the memory cap errors the lane and
+    # must NOT write the stack slot (w1_mask = run & PUSHES & ~veto)
+    code2 = assemble(("push4", 0x7FFFFFFF), "MLOAD", "STOP")
+    img2 = ContractImage.from_bytecode(code2, TEST_LIMITS.max_code)
+    corpus2 = Corpus.from_images([img2])
+    f2 = make_frontier(1, TEST_LIMITS)
+    out2 = run(f2, make_env(1), corpus2, max_steps=8)
+    assert bool(out2.error[0])  # OOB_MEM trap
+    # the MLOAD destination slot (sp-1, slot 0) still holds the pushed
+    # offset, not a zero-fill gather result
+    top = np.asarray(out2.stack)[0, 0]
+    assert int(top[0]) == 0x7FFFFFFF and int(top[1:].sum()) == 0
