@@ -158,9 +158,74 @@ def _free_value(node_id: int, kind: int, index: int, asn: Assignment) -> int:
     return asn.scalars.get((kind, index), defaults.get(kind, 0))
 
 
+def _packed_tape(tape):
+    """ctypes-ready arrays for the native evaluator, cached on the tape
+    object (nodes are append-only; a length change invalidates)."""
+    import ctypes
+
+    nodes = tape.nodes
+    n = len(nodes)
+    cached = getattr(tape, "_native_pack", None)
+    if cached is not None and cached[0] == n:
+        return cached
+    op = (ctypes.c_int32 * n)()
+    a = (ctypes.c_int32 * n)()
+    b = (ctypes.c_int32 * n)()
+    imm = bytearray(n * 32)
+    leaves = []
+    FREE = int(SymOp.FREE)
+    for i, nd in enumerate(nodes):
+        op[i], a[i], b[i] = nd.op, nd.a, nd.b
+        if nd.imm:
+            imm[i * 32:(i + 1) * 32] = (nd.imm & M256).to_bytes(32, "big")
+        if nd.op == FREE:
+            leaves.append(i)
+    pack = (n, op, a, b, bytes(imm), tuple(leaves))
+    try:
+        tape._native_pack = pack  # HostTape is a plain dataclass
+    except Exception:
+        pass
+    return pack
+
+
+def _evaluate_native(tape, asn: Assignment, lib) -> Optional[List[int]]:
+    import ctypes
+
+    n, op, a, b, imm, leaves = _packed_tape(tape)
+    vals = bytearray(n * 32)
+    for i in leaves:
+        nd = tape.nodes[i]
+        v = _free_value(i, nd.a, nd.b, asn) & M256
+        if v:
+            vals[i * 32:(i + 1) * 32] = v.to_bytes(32, "big")
+    buf = (ctypes.c_uint8 * len(vals)).from_buffer(vals)
+    rc = lib.tape_eval(n, op, a, b, imm,
+                       ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        return None
+    mv = memoryview(vals)
+    return [int.from_bytes(mv[i * 32:(i + 1) * 32], "big") for i in range(n)]
+
+
 def evaluate(tape, asn: Assignment) -> List[int]:
     """Value of every node under `asn` (keccak chains evaluated exactly).
-    Returns vals[id]; chain-carrier nodes (SEED/ABS) hold 0."""
+    Returns vals[id]; chain-carrier nodes (SEED/ABS) hold 0.
+
+    Dispatches to the native (C) evaluator when available — the witness
+    search calls this hundreds of times per query; the Python big-int
+    loop below is the semantic reference and the fallback
+    (``MYTHRIL_NO_NATIVE=1``)."""
+    from ..native import tape_eval_lib
+
+    lib = tape_eval_lib()
+    if lib is not None:
+        out = _evaluate_native(tape, asn, lib)
+        if out is not None:
+            return out
+    return _evaluate_py(tape, asn)
+
+
+def _evaluate_py(tape, asn: Assignment) -> List[int]:
     n = len(tape.nodes)
     vals = [0] * n
     # chain id -> (bytes-so-far, declared_len, start_offset_in_first_word)

@@ -1,0 +1,185 @@
+"""Native (C) tape evaluator vs the pure-Python semantic reference.
+
+The C evaluator (mythril_tpu/native/tape_eval.c) must agree bit-for-bit
+with smt/eval.py's Python big-int loop on every SymOp, including EVM
+division-by-zero semantics, signed edge cases at 2^255, shift
+saturation, and exact keccak chains. Random tapes + directed edges.
+"""
+
+import os
+import random
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.native import tape_eval_lib
+from mythril_tpu.smt.eval import (Assignment, M256, _evaluate_native,
+                                  _evaluate_py, evaluate)
+from mythril_tpu.smt.tape import HostNode, HostTape
+from mythril_tpu.symbolic.ops import FreeKind, SymOp
+
+pytestmark = pytest.mark.skipif(
+    tape_eval_lib() is None, reason="no C compiler for the native evaluator")
+
+N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)
+
+BINOPS = [SymOp.ADD, SymOp.SUB, SymOp.MUL, SymOp.DIV, SymOp.SDIV,
+          SymOp.MOD, SymOp.SMOD, SymOp.SIGNEXTEND, SymOp.LT, SymOp.GT,
+          SymOp.SLT, SymOp.SGT, SymOp.EQ, SymOp.AND, SymOp.OR, SymOp.XOR,
+          SymOp.BYTE, SymOp.SHL, SymOp.SHR, SymOp.SAR]
+
+EDGE = [0, 1, 2, 31, 32, 255, 256, 257, (1 << 255) - 1, 1 << 255,
+        (1 << 255) + 1, M256, M256 - 1, 0xFF << 248]
+
+
+def both(tape, asn=None):
+    asn = asn or Assignment()
+    lib = tape_eval_lib()
+    got = _evaluate_native(tape, asn, lib)
+    want = _evaluate_py(tape, asn)
+    assert got == want, (
+        [(i, hex(g), hex(w)) for i, (g, w) in enumerate(zip(got, want))
+         if g != w][:5])
+    return want
+
+
+def test_directed_edge_cases_all_binops():
+    for opn in BINOPS:
+        for x in EDGE:
+            for y in EDGE:
+                nodes = [N(SymOp.NULL), N(SymOp.CONST, imm=x),
+                         N(SymOp.CONST, imm=y), N(opn, 1, 2)]
+                both(HostTape(nodes=nodes, constraints=[]))
+
+
+def test_exp_not_iszero_edges():
+    for x in (0, 1, 2, 3, 257, 1 << 255, M256):
+        for y in (0, 1, 2, 31, 255, 256, M256):
+            nodes = [N(SymOp.NULL), N(SymOp.CONST, imm=x),
+                     N(SymOp.CONST, imm=y), N(SymOp.EXP, 1, 2),
+                     N(SymOp.NOT, 1), N(SymOp.ISZERO, 1)]
+            both(HostTape(nodes=nodes, constraints=[]))
+
+
+def test_random_dags_with_free_leaves():
+    rng = random.Random(11)
+    for trial in range(30):
+        nodes = [N(SymOp.NULL)]
+        asn = Assignment()
+        for k in range(4):
+            nodes.append(N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 4 + 32 * k))
+            asn.tx(0).write_word(4 + 32 * k, rng.getrandbits(256))
+        for _ in range(40):
+            opn = rng.choice(BINOPS + [SymOp.NOT, SymOp.ISZERO])
+            hi = len(nodes) - 1
+            a = rng.randint(1, hi)
+            b = rng.randint(1, hi)
+            nodes.append(N(opn, a, b))
+        both(HostTape(nodes=nodes, constraints=[]), asn)
+
+
+def test_keccak_chain_matches_python():
+    from mythril_tpu.ops.keccak import keccak256_host_int
+
+    # chain hashing two words (a mapping-key shape: key ++ slot)
+    w0, w1 = 0xDEADBEEF, 7
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.CONST, imm=w0),                      # 1
+        N(SymOp.KECCAK_SEED, imm=64),                # 2: 64-byte hash
+        N(SymOp.KECCAK_ABS, 2, 1),                   # 3: absorb node 1
+        N(SymOp.KECCAK_ABS, 3, 0, imm=w1),           # 4: absorb const w1
+        N(SymOp.KECCAK, 4),                          # 5: digest
+    ]
+    vals = both(HostTape(nodes=nodes, constraints=[]))
+    expect = keccak256_host_int(
+        w0.to_bytes(32, "big") + w1.to_bytes(32, "big"))
+    assert vals[5] == expect
+
+    # offset chain (start=4 in the first word, 32 bytes: unaligned read)
+    seed_imm = (4 << 32) | 32
+    nodes2 = [
+        N(SymOp.NULL),
+        N(SymOp.CONST, imm=w0),
+        N(SymOp.KECCAK_SEED, imm=seed_imm),
+        N(SymOp.KECCAK_ABS, 2, 1),
+        N(SymOp.KECCAK_ABS, 3, 0, imm=w1),
+        N(SymOp.KECCAK, 4),
+    ]
+    vals2 = both(HostTape(nodes=nodes2, constraints=[]))
+    blob = w0.to_bytes(32, "big") + w1.to_bytes(32, "big")
+    assert vals2[5] == keccak256_host_int(blob[4:36])
+
+    # multi-block sponge: chains past the 136-byte keccak rate (135 /
+    # 136 / 137-boundary plus a 2-block case) pin the C absorb loop
+    for n_words in (5, 6, 9):  # 160, 192, 288 bytes
+        words = [(0x1111 * (k + 1)) for k in range(n_words)]
+        nodes3 = [N(SymOp.NULL), N(SymOp.KECCAK_SEED, imm=32 * n_words)]
+        chain = 1
+        for w in words:
+            nodes3.append(N(SymOp.KECCAK_ABS, chain, 0, imm=w))
+            chain = len(nodes3) - 1
+        nodes3.append(N(SymOp.KECCAK, chain))
+        vals3 = both(HostTape(nodes=nodes3, constraints=[]))
+        blob3 = b"".join(w.to_bytes(32, "big") for w in words)
+        assert vals3[-1] == keccak256_host_int(blob3)
+    # exact rate boundaries via the declared-length clamp (135/136/137)
+    for ln in (135, 136, 137):
+        nodes4 = [N(SymOp.NULL), N(SymOp.KECCAK_SEED, imm=ln)]
+        chain = 1
+        for k in range(5):  # 160 bytes accumulated, hash first `ln`
+            nodes4.append(N(SymOp.KECCAK_ABS, chain, 0, imm=0xAB00 + k))
+            chain = len(nodes4) - 1
+        nodes4.append(N(SymOp.KECCAK, chain))
+        vals4 = both(HostTape(nodes=nodes4, constraints=[]))
+        blob4 = b"".join((0xAB00 + k).to_bytes(32, "big") for k in range(5))
+        assert vals4[-1] == keccak256_host_int(blob4[:ln])
+
+
+def test_unknown_op_falls_back_to_python():
+    """A SymOp the C evaluator doesn't know must return an error rc (the
+    front door then uses the Python path) — never silent zeros."""
+    import ctypes
+
+    from mythril_tpu.smt.eval import _packed_tape
+
+    nodes = [N(SymOp.NULL), N(SymOp.CONST, imm=3), N(99, 1, 1)]
+    t = HostTape(nodes=nodes, constraints=[])
+    lib = tape_eval_lib()
+    n, op, a, b, imm, leaves = _packed_tape(t)
+    vals = bytearray(n * 32)
+    buf = (ctypes.c_uint8 * len(vals)).from_buffer(vals)
+    rc = lib.tape_eval(n, op, a, b, imm,
+                       ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)))
+    assert rc != 0
+
+
+def test_evaluate_front_door_uses_native_and_repack_on_growth():
+    t = HostTape(nodes=[N(SymOp.NULL), N(SymOp.CONST, imm=5),
+                        N(SymOp.CONST, imm=6), N(SymOp.ADD, 1, 2)],
+                 constraints=[])
+    asn = Assignment()
+    assert evaluate(t, asn)[3] == 11
+    # append (intern) and re-evaluate: the pack cache must refresh
+    t.nodes.append(N(SymOp.MUL, 1, 2))
+    assert evaluate(t, asn)[4] == 30
+
+
+def test_solver_search_on_native_evaluator():
+    """The witness search rides the native evaluator end-to-end: invert
+    an EQ over a calldata word and verify the model concretely."""
+    from mythril_tpu.smt.solver import _SOLVE_CACHE, solve_tape_ex
+
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),   # 1
+        N(SymOp.CONST, imm=0xCAFEBABE),                  # 2
+        N(SymOp.ADD, 1, 2),                              # 3
+        N(SymOp.CONST, imm=0xFFFF0000),                  # 4
+        N(SymOp.EQ, 3, 4),                               # 5
+    ]
+    t = HostTape(nodes=nodes, constraints=[(5, True)])
+    _SOLVE_CACHE.clear()
+    verdict, asn = solve_tape_ex(t)
+    assert verdict == "sat"
+    assert (asn.read_calldata_word(0) + 0xCAFEBABE) & M256 == 0xFFFF0000
