@@ -22,7 +22,7 @@ from typing import List
 import numpy as np
 
 from ....symbolic.ops import SymOp
-from ....smt.tape import HostNode, HostTape, intern_node
+from ....smt.tape import HostNode, HostTape, cone, intern_node
 from ....smt.solver import solve_tape
 from ...report import Issue
 from ..base import DetectionModule, EntryPoint
@@ -37,6 +37,19 @@ class IntegerArithmetics(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["ADD", "SUB", "MUL", "EXP"]
 
+    @staticmethod
+    def _lane_sinks(sf, lane: int) -> list:
+        """Node ids where a wrapped result becomes an effect the chain
+        can observe: storage keys/values, call targets/values, log
+        topics/data (reference: the OverUnderflowAnnotation is reported
+        only when it reaches an SSTORE/CALL-family/state sink ⚠unv)."""
+        out = []
+        for arr in (sf.st_val_sym, sf.st_key_sym, sf.call_to_sym,
+                    sf.call_value_sym, sf.log_topic0_sym, sf.log_data0_sym):
+            row = np.asarray(arr[lane])
+            out.extend(int(x) for x in row[row > 0])
+        return out
+
     def _execute(self, ctx) -> List[Issue]:
         issues: List[Issue] = []
         sf = ctx.sf
@@ -47,20 +60,45 @@ class IntegerArithmetics(DetectionModule):
         arith_r = np.asarray(sf.arith_r)
         arith_pc = np.asarray(sf.arith_pc)
         arith_cid = np.asarray(sf.arith_cid)
+        retval_len = np.asarray(sf.base.retval_len)
         for lane in ctx.lanes():
             n = int(n_arith[lane])
             if n == 0:
                 continue
+            # annotation-channel sink gate (reference: the
+            # OverUnderflowAnnotation rides expression annotations and is
+            # reported only at sinks ⚠unv SURVEY §3.3): the wrapped result
+            # must REACH an observable effect — storage, call, log, or a
+            # path constraint (JUMPI guard; genuinely guarded ops are then
+            # proven unsat by the interned predicate, not lost here).
+            # RETURN data flows aren't tracked, so a lane that halted
+            # RETURNing data keeps the permissive pre-annotation behavior
+            # (the wrapped value may have flowed into that output); only
+            # STOP/effect-only lanes are filtered. One backward cone pass
+            # per lane answers every event's reachability query.
+            base = ctx.tape(lane)
+            sink_cone = None
+            if int(retval_len[lane]) == 0:
+                sinks = self._lane_sinks(sf, lane)
+                sinks.extend(int(nd) for nd, _ in base.constraints)
+                if sinks:
+                    sink_cone = cone(base, sinks)
             for j in range(min(n, arith_op.shape[1])):
                 op = int(arith_op[lane, j])
                 pc = int(arith_pc[lane, j])
                 cid = int(arith_cid[lane, j])
                 if self._seen(cid, pc):
                     continue
+                if op not in (0x01, 0x02, 0x03):
+                    continue  # EXP: v1 skip (before any sink work)
                 a = int(arith_a[lane, j])
                 b = int(arith_b[lane, j])
                 r = int(arith_r[lane, j])
-                base = ctx.tape(lane)
+                if sink_cone is not None and r not in sink_cone:
+                    # wrapped value never reaches an effect on this
+                    # lane; another lane may still decide this pc
+                    self._cache.discard((cid, pc))
+                    continue
                 nodes = list(base.nodes)
                 idx = dict(ctx.tape_index(lane))
                 cons = list(base.constraints)
@@ -85,8 +123,6 @@ class IntegerArithmetics(DetectionModule):
                         nodes, HostNode(int(SymOp.EQ), did, a, 0), idx),
                         False))
                     word = "overflow"
-                else:
-                    continue  # EXP: v1 skip
                 asn = solve_tape(HostTape(nodes=nodes, constraints=cons),
                                  max_iters=ctx.solver_iters)
                 if asn is None:

@@ -92,6 +92,77 @@ def constraint_support(tape: HostTape):
     return ids, kinds
 
 
+class AnnotationSpace:
+    """Reference-parity annotation channel (``laser/smt`` wrappers carry
+    an ``annotations`` set propagated through every operation ⚠unv,
+    SURVEY.md §2.1 "SMT abstraction layer" — the mechanism taint
+    analysis rides on). Here an expression IS its tape row, so the
+    channel is computed over the SSA DAG instead of being carried on
+    Python objects: ``annotate`` tags a node, ``annotations`` returns
+    the union of tags over the node's dependency cone. One linear
+    bottom-up pass (children precede parents in SSA order), memoized
+    until the next ``annotate``."""
+
+    def __init__(self, tape: HostTape):
+        self.tape = tape
+        self._own: dict = {}
+        self._eff: list | None = None
+
+    def annotate(self, node: int, tag) -> None:
+        self._own.setdefault(node, set()).add(tag)
+        self._eff = None
+
+    def _compute(self):
+        nodes = self.tape.nodes
+        n = len(nodes)
+        eff: list = [frozenset()] * n
+        leafish = (int(SymOp.CONST), int(SymOp.NULL), int(SymOp.FREE))
+        for i in range(1, n):
+            nd = nodes[i]
+            acc = self._own.get(i)
+            inherited: set = set(acc) if acc else set()
+            if nd.op not in leafish:
+                if 0 < nd.a < i:
+                    inherited |= eff[nd.a]
+                if 0 < nd.b < i:
+                    inherited |= eff[nd.b]
+            eff[i] = frozenset(inherited)
+        self._eff = eff
+        return eff
+
+    def annotations(self, node: int) -> frozenset:
+        eff = self._eff if self._eff is not None else self._compute()
+        if 0 <= node < len(eff):
+            return eff[node]
+        return frozenset()
+
+    def any_sink(self, sinks, tag) -> bool:
+        """Does `tag` reach any node id in `sinks`?"""
+        return any(tag in self.annotations(int(s)) for s in sinks)
+
+
+def cone(tape: HostTape, roots) -> set:
+    """Node ids in the dependency cone of ``roots`` — the backward
+    closure over the DAG (every node whose value can influence any
+    root). One pass; the membership query ``r in cone(tape, sinks)`` is
+    the bulk form of ``AnnotationSpace.any_sink`` for callers that only
+    need reachability."""
+    nodes = tape.nodes
+    n = len(nodes)
+    leafish = (int(SymOp.CONST), int(SymOp.NULL), int(SymOp.FREE))
+    seen: set = set()
+    stack = [int(r) for r in roots]
+    while stack:
+        i = stack.pop()
+        if i in seen or i <= 0 or i >= n:
+            continue
+        seen.add(i)
+        nd = nodes[i]
+        if nd.op not in leafish:
+            stack.extend((nd.a, nd.b))
+    return seen
+
+
 ATTACKER_KINDS = {
     int(FreeKind.CALLDATA_WORD), int(FreeKind.CALLDATASIZE),
     int(FreeKind.CALLVALUE), int(FreeKind.CALLER),

@@ -270,3 +270,34 @@ def test_solc_compile_error_surfaces(tmp_path):
     stub.chmod(0o755)
     with pytest.raises(SolcError, match="ParserError"):
         compile_solidity([str(sol)], solc_path=str(stub))
+
+
+def test_annotation_space_propagation():
+    """Annotation channel (reference: laser/smt annotations riding every
+    operation): tags reach derived nodes and keccak chains, not
+    independent subtrees; annotate invalidates the memo."""
+    from mythril_tpu.smt.tape import AnnotationSpace, HostNode, HostTape
+    from mythril_tpu.symbolic.ops import FreeKind, SymOp
+
+    N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),  # 1
+        N(SymOp.CONST, imm=7),                          # 2
+        N(SymOp.ADD, 1, 2),                             # 3
+        N(SymOp.AND, 3, 2),                             # 4: derived from 3
+        N(SymOp.MUL, 2, 2),                             # 5: independent
+        N(SymOp.KECCAK_SEED, imm=32),                   # 6
+        N(SymOp.KECCAK_ABS, 6, 4),                      # 7: absorbs node 4
+        N(SymOp.KECCAK, 7),                             # 8: digest
+    ]
+    t = HostTape(nodes=nodes, constraints=[])
+    sp = AnnotationSpace(t)
+    sp.annotate(3, "wrap")
+    assert "wrap" in sp.annotations(3)
+    assert "wrap" in sp.annotations(4)
+    assert "wrap" in sp.annotations(8)      # through the keccak chain
+    assert "wrap" not in sp.annotations(5)
+    assert sp.any_sink([8], "wrap") and not sp.any_sink([5], "wrap")
+    sp.annotate(5, "other")
+    assert "other" in sp.annotations(5)

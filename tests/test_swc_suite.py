@@ -219,3 +219,55 @@ def test_unknown_rate_bound_across_suite():
     assert total >= 10, f"suite exercised too few solver queries: {d}"
     assert d["unknown"] / total < 0.10, (
         f"undecided rate {d['unknown']}/{total} breaches the 10% bound: {d}")
+
+
+# --- round-4 annotation channel: overflow must reach a sink ---
+
+def test_unsunk_overflow_not_flagged_101():
+    # the overflowable ADD result is POPped — it never reaches storage,
+    # a call, a log, or a guard; the annotation channel drops it
+    # (reference: OverUnderflowAnnotation reported only at sinks). The
+    # unrelated store is SYMBOLIC so the lane has a recorded sink the
+    # wrapped value provably cannot reach (a lane with no sinks at all
+    # keeps the permissive behavior — RETURN flows aren't tracked).
+    code = assemble(
+        4, "CALLDATALOAD", ("push1", 1), "ADD", "POP",
+        36, "CALLDATALOAD", ("push1", 0), "SSTORE",
+        "STOP",
+    )
+    report = analyze(code)
+    assert "101" not in swcs(report)
+
+
+def test_sunk_overflow_still_flagged_101():
+    code = assemble(
+        4, "CALLDATALOAD", ("push1", 1), "ADD",
+        ("push1", 0), "SSTORE", "STOP",
+    )
+    report = analyze(code)
+    assert "101" in swcs(report)
+
+
+def test_overflow_through_mask_to_store_flagged_101():
+    # the wrapped sum flows through AND before being stored: the
+    # annotation must propagate through derived nodes, not just direct
+    code = assemble(
+        4, "CALLDATALOAD", 36, "CALLDATALOAD", "ADD",
+        ("push32", (1 << 256) - 1), "AND",
+        ("push1", 0), "SSTORE", "STOP",
+    )
+    report = analyze(code)
+    assert "101" in swcs(report)
+
+
+def test_overflow_flowing_to_return_still_flagged_101():
+    # RETURN data flows are untracked: a lane that halts returning data
+    # keeps the permissive behavior, so an overflow whose only outlet is
+    # the returned word is still reported (reference: _handle_return sink)
+    code = assemble(
+        4, "CALLDATALOAD", ("push1", 1), "ADD",
+        ("push1", 0), "MSTORE",
+        ("push1", 32), ("push1", 0), "RETURN",
+    )
+    report = analyze(code)
+    assert "101" in swcs(report)
