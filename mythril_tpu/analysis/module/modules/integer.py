@@ -11,8 +11,11 @@ the predicate is assembled host-side on the extracted tape:
 - MUL overflow  ⇔ b != 0 and (a*b mod 2^256)/b != a
                                                 -> ISZERO(b) == false
                                                    and EQ(DIV(r,b), a) == false
-- EXP is recorded but skipped in v1 (the reference models it via its
-  ExponentFunctionManager; revisit with the exponent concretization).
+- EXP overflow (sufficient condition) ⇔ base > 1 and exponent > 255 —
+  then base^exp >= 2^256 must wrap (the reference concretizes via its
+  ExponentFunctionManager; this sound subset catches the
+  attacker-controlled-exponent pattern without false positives on
+  powers that provably fit).
 """
 
 from __future__ import annotations
@@ -40,12 +43,13 @@ class IntegerArithmetics(DetectionModule):
     @staticmethod
     def _lane_sinks(sf, lane: int) -> list:
         """Node ids where a wrapped result becomes an effect the chain
-        can observe: storage keys/values, call targets/values, log
-        topics/data (reference: the OverUnderflowAnnotation is reported
-        only when it reaches an SSTORE/CALL-family/state sink ⚠unv)."""
+        can observe (reference: the OverUnderflowAnnotation is reported
+        only when it reaches an SSTORE/CALL-family/state sink ⚠unv).
+        Storage keys/values only — the gate in ``_execute`` is
+        permissive on lanes with calls/logs/returns, whose payloads are
+        not fully recorded as node ids."""
         out = []
-        for arr in (sf.st_val_sym, sf.st_key_sym, sf.call_to_sym,
-                    sf.call_value_sym, sf.log_topic0_sym, sf.log_data0_sym):
+        for arr in (sf.st_val_sym, sf.st_key_sym):
             row = np.asarray(arr[lane])
             out.extend(int(x) for x in row[row > 0])
         return out
@@ -61,6 +65,10 @@ class IntegerArithmetics(DetectionModule):
         arith_pc = np.asarray(sf.arith_pc)
         arith_cid = np.asarray(sf.arith_cid)
         retval_len = np.asarray(sf.base.retval_len)
+        n_calls = np.asarray(sf.n_calls)
+        n_logs = np.asarray(sf.base.n_logs)
+        rv_havoc = np.asarray(sf.rv_havoc)
+        A = int(sf.base.acct_used.shape[1])
         for lane in ctx.lanes():
             n = int(n_arith[lane])
             if n == 0:
@@ -68,29 +76,37 @@ class IntegerArithmetics(DetectionModule):
             # annotation-channel sink gate (reference: the
             # OverUnderflowAnnotation rides expression annotations and is
             # reported only at sinks ⚠unv SURVEY §3.3): the wrapped result
-            # must REACH an observable effect — storage, call, log, or a
+            # must REACH an observable effect — a storage key/value or a
             # path constraint (JUMPI guard; genuinely guarded ops are then
             # proven unsat by the interned predicate, not lost here).
-            # RETURN data flows aren't tracked, so a lane that halted
-            # RETURNing data keeps the permissive pre-annotation behavior
-            # (the wrapped value may have flowed into that output); only
-            # STOP/effect-only lanes are filtered. One backward cone pass
-            # per lane answers every event's reachability query.
+            # The gate only engages on lanes whose EVERY outlet is
+            # tracked: a lane that returned data (or a symbolic-offset
+            # RETURN, rv_havoc), made any call (argument memory is not
+            # recorded as node ids), or emitted any log (only
+            # topic0/data0 are recorded) keeps the permissive
+            # pre-annotation behavior — the wrapped value may have left
+            # through the untracked channel. FREE(STORAGE) leaves
+            # traverse into their symbolic key (which slot a read hits
+            # depends on the key), via storage_key_div=A.
             base = ctx.tape(lane)
             sink_cone = None
-            if int(retval_len[lane]) == 0:
+            all_outlets_tracked = (
+                int(retval_len[lane]) == 0 and int(n_calls[lane]) == 0
+                and int(n_logs[lane]) == 0 and not bool(rv_havoc[lane])
+            )
+            if all_outlets_tracked:
                 sinks = self._lane_sinks(sf, lane)
                 sinks.extend(int(nd) for nd, _ in base.constraints)
                 if sinks:
-                    sink_cone = cone(base, sinks)
+                    sink_cone = cone(base, sinks, storage_key_div=A)
             for j in range(min(n, arith_op.shape[1])):
                 op = int(arith_op[lane, j])
                 pc = int(arith_pc[lane, j])
                 cid = int(arith_cid[lane, j])
                 if self._seen(cid, pc):
                     continue
-                if op not in (0x01, 0x02, 0x03):
-                    continue  # EXP: v1 skip (before any sink work)
+                if op not in (0x01, 0x02, 0x03, 0x0A):
+                    continue
                 a = int(arith_a[lane, j])
                 b = int(arith_b[lane, j])
                 r = int(arith_r[lane, j])
@@ -122,6 +138,23 @@ class IntegerArithmetics(DetectionModule):
                     cons.append((intern_node(
                         nodes, HostNode(int(SymOp.EQ), did, a, 0), idx),
                         False))
+                    word = "overflow"
+                else:  # 0x0A EXP — sufficient condition: base >= 2 and
+                    # exponent > 255 forces base^exp >= 2^256 to wrap.
+                    # (The reference concretizes via its
+                    # ExponentFunctionManager ⚠unv; this sound subset
+                    # catches the unbounded attacker-exponent pattern and
+                    # never flags a power that provably fits.)
+                    cons.append((intern_node(
+                        nodes, HostNode(int(SymOp.GT), a,
+                                        intern_node(nodes, HostNode(
+                                            int(SymOp.CONST), 0, 0, 1), idx),
+                                        0), idx), True))
+                    cons.append((intern_node(
+                        nodes, HostNode(int(SymOp.GT), b,
+                                        intern_node(nodes, HostNode(
+                                            int(SymOp.CONST), 0, 0, 255),
+                                            idx), 0), idx), True))
                     word = "overflow"
                 asn = solve_tape(HostTape(nodes=nodes, constraints=cons),
                                  max_iters=ctx.solver_iters)

@@ -92,64 +92,18 @@ def constraint_support(tape: HostTape):
     return ids, kinds
 
 
-class AnnotationSpace:
-    """Reference-parity annotation channel (``laser/smt`` wrappers carry
-    an ``annotations`` set propagated through every operation ⚠unv,
-    SURVEY.md §2.1 "SMT abstraction layer" — the mechanism taint
-    analysis rides on). Here an expression IS its tape row, so the
-    channel is computed over the SSA DAG instead of being carried on
-    Python objects: ``annotate`` tags a node, ``annotations`` returns
-    the union of tags over the node's dependency cone. One linear
-    bottom-up pass (children precede parents in SSA order), memoized
-    until the next ``annotate``."""
-
-    def __init__(self, tape: HostTape):
-        self.tape = tape
-        self._own: dict = {}
-        self._eff: list | None = None
-
-    def annotate(self, node: int, tag) -> None:
-        self._own.setdefault(node, set()).add(tag)
-        self._eff = None
-
-    def _compute(self):
-        nodes = self.tape.nodes
-        n = len(nodes)
-        eff: list = [frozenset()] * n
-        leafish = (int(SymOp.CONST), int(SymOp.NULL), int(SymOp.FREE))
-        for i in range(1, n):
-            nd = nodes[i]
-            acc = self._own.get(i)
-            inherited: set = set(acc) if acc else set()
-            if nd.op not in leafish:
-                if 0 < nd.a < i:
-                    inherited |= eff[nd.a]
-                if 0 < nd.b < i:
-                    inherited |= eff[nd.b]
-            eff[i] = frozenset(inherited)
-        self._eff = eff
-        return eff
-
-    def annotations(self, node: int) -> frozenset:
-        eff = self._eff if self._eff is not None else self._compute()
-        if 0 <= node < len(eff):
-            return eff[node]
-        return frozenset()
-
-    def any_sink(self, sinks, tag) -> bool:
-        """Does `tag` reach any node id in `sinks`?"""
-        return any(tag in self.annotations(int(s)) for s in sinks)
-
-
-def cone(tape: HostTape, roots) -> set:
+def cone(tape: HostTape, roots, storage_key_div: int = 0) -> set:
     """Node ids in the dependency cone of ``roots`` — the backward
     closure over the DAG (every node whose value can influence any
-    root). One pass; the membership query ``r in cone(tape, sinks)`` is
-    the bulk form of ``AnnotationSpace.any_sink`` for callers that only
-    need reachability."""
+    root). ``storage_key_div`` is the account-table size ``A`` when the
+    caller wants FREE(STORAGE) leaves traversed into their symbolic key
+    node (the engine packs ``b = key_sym * A + account_slot``,
+    ``symbolic/engine.py`` SLOAD-miss leaf) — which slot a storage read
+    hits observably depends on the key, so taint flows through it."""
     nodes = tape.nodes
     n = len(nodes)
     leafish = (int(SymOp.CONST), int(SymOp.NULL), int(SymOp.FREE))
+    storage = int(FreeKind.STORAGE)
     seen: set = set()
     stack = [int(r) for r in roots]
     while stack:
@@ -160,7 +114,50 @@ def cone(tape: HostTape, roots) -> set:
         nd = nodes[i]
         if nd.op not in leafish:
             stack.extend((nd.a, nd.b))
+        elif (storage_key_div and nd.op == int(SymOp.FREE)
+                and nd.a == storage):
+            stack.append(nd.b // storage_key_div)
     return seen
+
+
+class AnnotationSpace:
+    """Reference-parity annotation channel (``laser/smt`` wrappers carry
+    an ``annotations`` set propagated through every operation ⚠unv,
+    SURVEY.md §2.1 "SMT abstraction layer" — the mechanism taint
+    analysis rides on). Here an expression IS its tape row, so the
+    channel is a thin view over :func:`cone`: a tag attached at node t
+    appears in ``annotations(x)`` exactly when t lies in x's dependency
+    cone. Single reachability implementation — sink-semantics fixes in
+    ``cone`` apply here automatically."""
+
+    def __init__(self, tape: HostTape, storage_key_div: int = 0):
+        self.tape = tape
+        self.storage_key_div = storage_key_div
+        self._own: dict = {}
+        self._cones: dict = {}
+
+    def annotate(self, node: int, tag) -> None:
+        self._own.setdefault(int(node), set()).add(tag)
+
+    def _cone_of(self, node: int) -> set:
+        c = self._cones.get(node)
+        if c is None:
+            c = cone(self.tape, [node], self.storage_key_div)
+            self._cones[node] = c
+        return c
+
+    def annotations(self, node: int) -> frozenset:
+        c = self._cone_of(int(node))
+        out = set()
+        for t, tags in self._own.items():
+            if t in c:
+                out |= tags
+        return frozenset(out)
+
+    def any_sink(self, sinks, tag) -> bool:
+        """Does `tag` reach any node id in `sinks`?"""
+        c = cone(self.tape, [int(s) for s in sinks], self.storage_key_div)
+        return any(tag in tags and t in c for t, tags in self._own.items())
 
 
 ATTACKER_KINDS = {

@@ -271,3 +271,37 @@ def test_overflow_flowing_to_return_still_flagged_101():
     )
     report = analyze(code)
     assert "101" in swcs(report)
+
+
+def test_exp_overflow_attacker_exponent_flagged_101():
+    # storage = 3 ** calldata: exponent is attacker-chosen, the power
+    # wraps for exp > 255 (sufficient-condition EXP predicate)
+    code = assemble(
+        4, "CALLDATALOAD", ("push1", 3), "EXP",
+        ("push1", 0), "SSTORE", "STOP",
+    )
+    report = analyze(code)
+    assert "101" in swcs(report)
+
+
+def test_exp_small_concrete_exponent_not_flagged_101():
+    # storage = calldata ** 2: the exponent is the CONSTANT 2, the
+    # GT(exp, 255) leg of the predicate is concretely false -> refuted
+    code = assemble(
+        ("push1", 2), 4, "CALLDATALOAD", "EXP",
+        ("push1", 0), "SSTORE", "STOP",
+    )
+    report = analyze(code)
+    assert "101" not in swcs(report)
+
+
+def test_overflow_as_storage_read_key_flagged_101():
+    # storage[0] = SLOAD(calldata + 1): the wrapped sum's only use is as
+    # a STORAGE-read key — which slot is read observably depends on it,
+    # so cone() must traverse the FREE(STORAGE) leaf into its key node
+    code = assemble(
+        4, "CALLDATALOAD", ("push1", 1), "ADD", "SLOAD",
+        ("push1", 0), "SSTORE", "STOP",
+    )
+    report = analyze(code)
+    assert "101" in swcs(report)
