@@ -18,13 +18,21 @@ from .symbolic import coverage_summary
 log = logging.getLogger(__name__)
 
 
-def fire_lasers(target, white_list: Optional[List[str]] = None) -> Report:
+def fire_lasers(target, white_list: Optional[List[str]] = None,
+                parallel: bool = False) -> Report:
     """`target` is an AnalysisContext or a SymExecWrapper; a wrapper's
     per-transaction context snapshots are all scanned (module issue caches
     dedup repeat findings across txs). Witness-search statistics are
     tallied per module (reference: ``SolverStatistics`` ⚠unv, SURVEY §5.1)
     and attached to the report's coverage block — the ``unknown`` column
-    is the silently-dropped-findings channel (VERDICT r2 weak #3)."""
+    is the silently-dropped-findings channel (VERDICT r2 weak #3).
+
+    ``parallel`` (reference: ``--parallel-solving`` ⚠unv) runs the
+    detection modules of each tx context concurrently in a thread pool:
+    the witness search is host Python whose hot loop sits in the native C
+    tape evaluator, so module-level threads overlap the GIL-released
+    evaluator calls. Per-module solver accounting is serial-only (the
+    process-wide counter can't attribute interleaved deltas)."""
     from ..smt.solver import SOLVER_STATS
 
     contexts = getattr(target, "tx_contexts", None) or [target]
@@ -40,22 +48,46 @@ def fire_lasers(target, white_list: Optional[List[str]] = None) -> Report:
     modules = loader.get_detection_modules(white_list)
     run_start = SOLVER_STATS.snapshot()
     by_module = {}
+
+    def run_module(module, ctx):
+        # consume incrementally: issues yielded BEFORE a module crashes
+        # must survive the exception (a bare list() would discard them)
+        out = []
+        try:
+            for issue in module.execute(ctx):
+                out.append(issue)
+        except Exception:  # noqa: BLE001 — degrade like the reference
+            log.exception("detection module %s failed", module.name)
+        return out
+
     for ctx in contexts:
+        if parallel and len(modules) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # pre-build the shared tape cache serially: module threads
+            # then only read it (lazy per-lane extraction under the GIL
+            # is benign — duplicate work at worst, never a wrong tape)
+            lanes = ctx.lanes(include_errors=True, include_reverted=True)
+            if len(lanes):
+                ctx.tape(int(lanes[0]))
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(modules))) as pool:
+                for issues in pool.map(lambda m: run_module(m, ctx), modules):
+                    for issue in issues:
+                        report.append(issue)
+            continue
         for module in modules:
             before = SOLVER_STATS.snapshot()
-            try:
-                for issue in module.execute(ctx):
-                    report.append(issue)
-            except Exception:  # noqa: BLE001 — degrade like the reference
-                log.exception("detection module %s failed", module.name)
-            finally:
-                d = SOLVER_STATS.delta(before)
-                if d["attempts"]:
-                    agg = by_module.setdefault(
-                        module.name,
-                        {"attempts": 0, "sat": 0, "unknown": 0, "time_sec": 0.0})
-                    for k in agg:
-                        agg[k] = round(agg[k] + d[k], 3)
+            issues = run_module(module, ctx)
+            for issue in issues:
+                report.append(issue)
+            d = SOLVER_STATS.delta(before)
+            if d["attempts"]:
+                agg = by_module.setdefault(
+                    module.name,
+                    {"attempts": 0, "sat": 0, "unknown": 0, "time_sec": 0.0})
+                for k in agg:
+                    agg[k] = round(agg[k] + d[k], 3)
     if report.coverage is not None:
         report.coverage["solver"] = {
             "total": SOLVER_STATS.delta(run_start),

@@ -35,6 +35,7 @@ class AnalysisContext:
     limits: LimitsConfig
     contract_names: List[str]
     solver_iters: int = 400
+    solver_timeout: Optional[float] = None  # seconds per query (None = off)
     # lanes newly errored during THIS transaction, per trap name (filled by
     # SymExecWrapper; None for standalone contexts, where coverage falls
     # back to reading the snapshot directly)
@@ -114,7 +115,8 @@ class AnalysisContext:
             for i, s in extra_constraints
         ]
         t = HostTape(nodes=nodes, constraints=cons)
-        return solve_tape(t, max_iters=self.solver_iters)
+        return solve_tape(t, max_iters=self.solver_iters,
+                          max_time=self.solver_timeout)
 
     def contract_of(self, lane: int) -> int:
         return int(np.asarray(self.sf.base.contract_id[lane]))
@@ -232,9 +234,11 @@ class SymExecWrapper:
         lanes_per_contract: int = 64,
         max_steps: int = 512,
         solver_iters: int = 400,
+        solver_timeout: Optional[float] = None,
         transaction_count: int = 1,
         creation_bytecodes: Optional[Sequence[bytes]] = None,
         execution_timeout: Optional[float] = None,
+        create_timeout: Optional[float] = None,
         checkpoint_dir: Optional[str] = None,
         deadline_chunk_steps: int = 64,
         plugins: Sequence = (),
@@ -433,6 +437,7 @@ class SymExecWrapper:
             ctx = AnalysisContext(
                 sf=sf, corpus=self.corpus, limits=limits,
                 contract_names=names, solver_iters=solver_iters,
+                solver_timeout=solver_timeout,
                 trap_counts=trap_counts, timed_out=self.timed_out,
             )
             self.tx_contexts.append(ctx)
@@ -454,10 +459,24 @@ class SymExecWrapper:
         self._cur_tx = 0
         self.plugin_loader.fire("initialize", self)
         if with_creation:
+            # --create-timeout (reference: a separate wall-clock budget
+            # for the creation transaction ⚠unv): narrow the deadline for
+            # the constructor run only, then restore — hitting the
+            # CREATION budget must not cancel the message-call phase
+            outer_deadline = self._deadline_at
+            if create_timeout is not None:
+                cd = _time.monotonic() + create_timeout
+                self._deadline_at = (cd if outer_deadline is None
+                                     else min(outer_deadline, cd))
             # a constructor needn't mutate storage for the deploy to count
             sf = run_one_tx(sf, is_last=False, handoff_kw=dict(
                 require_mutation=False, new_contract_id=cid_runtime))
             self._cur_tx += 1
+            if create_timeout is not None:
+                self._deadline_at = outer_deadline
+                if self.timed_out and (outer_deadline is None
+                                       or _time.monotonic() < outer_deadline):
+                    self.timed_out = False
         for t in range(transaction_count):
             if self.timed_out:
                 break  # deadline: report what was explored so far

@@ -51,6 +51,13 @@ def create_parser() -> argparse.ArgumentParser:
                    default="text")
     a.add_argument("--max-steps", type=int, default=512,
                    help="superstep budget per transaction")
+    a.add_argument("--max-depth", type=int, default=None,
+                   help="reference-name alias: per-path instruction depth "
+                        "== frontier superstep budget (overrides "
+                        "--max-steps when given)")
+    a.add_argument("--call-depth-limit", type=int, default=None,
+                   help="max nested CALL/CREATE frames per lane (reference "
+                        "default 3; here the frontier frame-stack cap)")
     a.add_argument("--lanes-per-contract", type=int, default=64,
                    help="frontier lanes (seed + fork headroom) per contract")
     a.add_argument("--loop-bound", type=int, default=None,
@@ -58,8 +65,17 @@ def create_parser() -> argparse.ArgumentParser:
                         "loops policy)")
     a.add_argument("--solver-iters", type=int, default=400,
                    help="witness-search repair iterations per query")
+    a.add_argument("--solver-timeout", type=int, default=None, metavar="MS",
+                   help="wall-clock budget per solver query, milliseconds "
+                        "(reference units); expiry degrades to no-issue")
+    a.add_argument("--parallel-solving", action="store_true",
+                   help="run detection modules concurrently (thread pool "
+                        "over the GIL-releasing native tape evaluator)")
     a.add_argument("--execution-timeout", type=float, default=None,
                    help="wall-clock budget in seconds for the exploration")
+    a.add_argument("--create-timeout", type=float, default=None,
+                   help="wall-clock budget in seconds for the CREATION "
+                        "transaction (constructor) only")
     a.add_argument("--strategy",
                    choices=["bfs", "dfs", "weighted-random", "coverage",
                             "beam"],
@@ -76,9 +92,17 @@ def create_parser() -> argparse.ArgumentParser:
     a.add_argument("--concrete-storage", action="store_true",
                    help="model unknown storage as zero instead of symbolic "
                         "(reference default; symbolic is --unconstrained-storage there)")
+    a.add_argument("--unconstrained-storage", action="store_true",
+                   help="model unknown storage as fully symbolic (this "
+                        "engine's default; the reference flag name, kept "
+                        "for parity — conflicts with --concrete-storage)")
     a.add_argument("--graph", metavar="PATH",
                    help="write the contract CFG as graphviz DOT, explored "
                         "blocks highlighted")
+    a.add_argument("--statespace-json", metavar="PATH",
+                   help="dump the explored statespace as JSON: per-tx "
+                        "surviving paths (pc, depth, constraints) + "
+                        "per-contract instruction coverage")
     a.add_argument("--enable-iprof", action="store_true",
                    help="print a per-opcode executed-instruction profile "
                         "after the report")
@@ -232,6 +256,10 @@ def exec_analyze(args) -> int:
     from ..mythril import MythrilAnalyzer, MythrilConfig
     from ..symbolic import SymSpec
 
+    if args.concrete_storage and args.unconstrained_storage:
+        print("error: --concrete-storage conflicts with "
+              "--unconstrained-storage", file=sys.stderr)
+        raise SystemExit(2)
     if getattr(args, "corpus", None):
         return _exec_campaign(args)
     contracts = _load_contracts(args)
@@ -243,14 +271,24 @@ def exec_analyze(args) -> int:
                 contracts[0], creation_code=_to_bytes(fh.read()))
     from ..config import DEFAULT_LIMITS, TEST_LIMITS
 
+    limits = TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS
+    if args.call_depth_limit is not None:
+        limits = dataclasses.replace(limits, call_depth=args.call_depth_limit)
     cfg = MythrilConfig(
-        limits=TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS,
+        limits=limits,
         transaction_count=args.transaction_count,
-        max_steps=args.max_steps,
+        # --max-depth is the reference name for the per-path depth budget;
+        # on the breadth-first frontier that IS the superstep budget
+        max_steps=(args.max_depth if args.max_depth is not None
+                   else args.max_steps),
         lanes_per_contract=args.lanes_per_contract,
         solver_iters=args.solver_iters,
+        solver_timeout=(args.solver_timeout / 1000.0
+                        if args.solver_timeout is not None else None),
+        parallel_solving=args.parallel_solving,
         loop_bound=args.loop_bound,
         execution_timeout=args.execution_timeout,
+        create_timeout=args.create_timeout,
         strategy=args.strategy,
         spec=SymSpec(storage=not args.concrete_storage),
         enable_iprof=args.enable_iprof,
@@ -261,6 +299,8 @@ def exec_analyze(args) -> int:
     report = analyzer.fire_lasers(modules=modules)
     if args.graph:
         _write_graph(args.graph, contracts[0], analyzer)
+    if args.statespace_json:
+        _write_statespace(args.statespace_json, analyzer)
     if args.outform == "json":
         print(report.as_json())
     elif args.outform == "jsonv2":
@@ -318,15 +358,30 @@ def _exec_campaign(args) -> int:
     from ..mythril.campaign import CorpusCampaign, load_corpus_dir
     from ..symbolic import SymSpec
 
+    import dataclasses
+
+    for flag, val in (("--create-timeout", args.create_timeout),
+                      ("--statespace-json", args.statespace_json)):
+        if val is not None:
+            print(f"warning: {flag} has no effect in campaign mode",
+                  file=sys.stderr)
     contracts = load_corpus_dir(args.corpus)
     num_hosts, host_index = _resolve_hosts(args)
+    limits = TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS
+    if args.call_depth_limit is not None:
+        limits = dataclasses.replace(limits, call_depth=args.call_depth_limit)
     campaign = CorpusCampaign(
         contracts,
         batch_size=args.batch_size,
         lanes_per_contract=args.lanes_per_contract,
-        limits=TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS,
+        limits=limits,
         spec=SymSpec(storage=not args.concrete_storage),
-        max_steps=args.max_steps,
+        max_steps=(args.max_depth if args.max_depth is not None
+                   else args.max_steps),
+        solver_timeout=(args.solver_timeout / 1000.0
+                        if args.solver_timeout is not None else None),
+        solver_iters=args.solver_iters,
+        parallel_solving=args.parallel_solving,
         transaction_count=args.transaction_count,
         modules=args.modules.split(",") if args.modules else None,
         checkpoint_dir=args.checkpoint_dir,
@@ -347,6 +402,56 @@ def _exec_campaign(args) -> int:
         out["issues_detail"] = res.issues
     print(json.dumps(out, indent=1))
     return 0
+
+
+def _write_statespace(path: str, analyzer) -> None:
+    """Explored-statespace JSON (reference: ``--statespace-json`` dumps
+    the LASER node/edge graph, ``analysis/traceexplore.py`` ⚠unv). The
+    frontier engine keeps no per-superstep node graph — its statespace IS
+    the lane set — so the dump is per-transaction surviving paths (pc,
+    frame depth, path-condition branches with their asserting pcs) plus
+    per-contract instruction coverage, which carries the same audit
+    content: what was reached, under which branch decisions."""
+    import json
+
+    import numpy as np
+
+    sym = analyzer.sym
+    out = {"transactions": [], "lanes": 0}
+    for ti, ctx in enumerate(sym.tx_contexts):
+        b = ctx.sf.base
+        act = np.asarray(b.active)
+        out["lanes"] = int(act.shape[0])
+        pcs = np.asarray(b.pc)
+        depth = np.asarray(b.depth)
+        halted = np.asarray(b.halted)
+        err = np.asarray(b.error)
+        rev = np.asarray(b.reverted)
+        cid = np.asarray(b.contract_id)
+        con_pc = np.asarray(ctx.sf.con_pc)
+        con_sign = np.asarray(ctx.sf.con_sign)
+        con_len = np.asarray(ctx.sf.con_len)
+        paths = []
+        for lane in np.where(act)[0]:
+            n = int(con_len[lane])
+            paths.append({
+                "lane": int(lane),
+                "contract": ctx.cid_name(int(cid[lane])),
+                "pc": int(pcs[lane]),
+                "depth": int(depth[lane]),
+                "halted": bool(halted[lane]),
+                "error": bool(err[lane]),
+                "reverted": bool(rev[lane]),
+                "branches": [
+                    {"pc": int(con_pc[lane, k]),
+                     "taken": bool(con_sign[lane, k])}
+                    for k in range(n) if int(con_pc[lane, k]) >= 0
+                ],
+            })
+        out["transactions"].append({"tx": ti, "paths": paths})
+    out["instruction_coverage_pct"] = sym.instruction_coverage()
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
 
 
 def _write_graph(path: str, contract, analyzer) -> None:

@@ -91,6 +91,13 @@ class CampaignResult:
                 self.paths_total / total, 1) if total else 0.0,
             "dropped_forks": self.dropped_forks,
             "solver": self.solver,
+            # headline observable for the silent-false-negative channel:
+            # share of solver queries that returned neither sat nor unsat
+            "solver_unknown_rate": (
+                round(self.solver.get("unknown", 0)
+                      / self.solver["attempts"], 4)
+                if self.solver.get("attempts") else 0.0
+            ),
             **({"iprof": self.iprof} if self.iprof else {}),
         }
 
@@ -115,6 +122,9 @@ class CorpusCampaign:
         enable_iprof: bool = False,
         num_hosts: int = 1,
         host_index: int = 0,
+        solver_timeout: Optional[float] = None,
+        solver_iters: int = 400,
+        parallel_solving: bool = False,
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -144,6 +154,9 @@ class CorpusCampaign:
         self.execution_timeout = execution_timeout
         self.plugins = list(plugins)
         self.enable_iprof = enable_iprof
+        self.solver_timeout = solver_timeout
+        self.solver_iters = solver_iters
+        self.parallel_solving = parallel_solving
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -173,6 +186,7 @@ class CorpusCampaign:
             return state
         return {"next_batch": 0, "issues": [], "batch_wall": [],
                 "paths_total": 0, "dropped_forks": 0, "iprof": {},
+                "solver": {},
                 "shard": [self.num_hosts, self.host_index,
                           len(self.contracts)]}
 
@@ -203,6 +217,12 @@ class CorpusCampaign:
         res.paths_total = int(state["paths_total"])
         res.dropped_forks = int(state["dropped_forks"])
         res.iprof = dict(state.get("iprof", {}))
+        # solver stats accumulate ACROSS sessions: the checkpoint carries
+        # the totals from prior (killed/resumed) sessions, this session's
+        # delta is added per batch — so the final report's sat/unsat/
+        # unknown split covers the whole campaign, not just the last
+        # session (VERDICT r4 weak #4: the miss rate must be observable)
+        solver_prior = dict(state.get("solver", {}))
         stats_at_start = SOLVER_STATS.snapshot()
 
         n_batches = (len(self.contracts) + self.batch_size - 1) // self.batch_size
@@ -221,11 +241,14 @@ class CorpusCampaign:
                 codes, contract_names=names, limits=self.limits,
                 spec=self.spec, lanes_per_contract=self.lanes_per_contract,
                 max_steps=self.max_steps,
+                solver_iters=self.solver_iters,
+                solver_timeout=self.solver_timeout,
                 transaction_count=self.transaction_count,
                 plugins=self.plugins,
                 enable_iprof=self.enable_iprof,
             )
-            report = fire_lasers(sym, white_list=self.modules)
+            report = fire_lasers(sym, white_list=self.modules,
+                                 parallel=self.parallel_solving)
             dt = time.monotonic() - t0
             cov = sym.coverage
             for issue in report.issues:
@@ -240,11 +263,14 @@ class CorpusCampaign:
             if self.enable_iprof:
                 for name, n in sym.iprof.items():
                     res.iprof[name] = res.iprof.get(name, 0) + n
+            sess = SOLVER_STATS.delta(stats_at_start)
             state.update(next_batch=bi + 1, issues=res.issues,
                          batch_wall=res.batch_wall,
                          paths_total=res.paths_total,
                          dropped_forks=res.dropped_forks,
-                         iprof=res.iprof)
+                         iprof=res.iprof,
+                         solver={k: round(solver_prior.get(k, 0) + v, 3)
+                                 for k, v in sess.items()})
             self._save_ckpt(state)
             if progress is not None:
                 progress(bi + 1, n_batches, dt, len(res.issues))
@@ -253,7 +279,9 @@ class CorpusCampaign:
         res.contracts = min(res.batches * self.batch_size, len(self.contracts))
         res.wall_sec = time.monotonic() - t_start
         res.compile_sec = res.batch_wall[0] if res.batch_wall else 0.0
-        res.solver = SOLVER_STATS.delta(stats_at_start)
+        sess = SOLVER_STATS.delta(stats_at_start)
+        res.solver = {k: round(solver_prior.get(k, 0) + v, 3)
+                      for k, v in sess.items()}
         return res
 
 
@@ -283,6 +311,9 @@ def merge_campaigns(results: Sequence[Dict]) -> Dict:
             if isinstance(v, (int, float)):
                 solver[k] = solver.get(k, 0) + v
     merged["solver"] = solver
+    merged["solver_unknown_rate"] = (
+        round(solver.get("unknown", 0) / solver["attempts"], 4)
+        if solver.get("attempts") else 0.0)
     iprof: Dict[str, int] = {}
     for r in results:
         for k, v in (r.get("iprof") or {}).items():

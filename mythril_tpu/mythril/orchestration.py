@@ -37,8 +37,11 @@ class MythrilConfig:
     max_steps: int = 512
     lanes_per_contract: int = 64
     solver_iters: int = 400
+    solver_timeout: Optional[float] = None  # seconds per solver query
     loop_bound: Optional[int] = None      # None = limits.loop_bound
     execution_timeout: Optional[float] = None  # seconds; None = unbounded
+    create_timeout: Optional[float] = None  # seconds for the creation tx
+    parallel_solving: bool = False        # detection modules in a thread pool
     strategy: str = "bfs"                 # bfs | dfs (fork-admission policy)
     enable_iprof: bool = False            # per-opcode instruction profiler
     plugins: tuple = ()                   # LaserPlugin instances (e.g. from
@@ -136,14 +139,17 @@ class MythrilAnalyzer:
             lanes_per_contract=cfg.lanes_per_contract,
             max_steps=cfg.max_steps,
             solver_iters=cfg.solver_iters,
+            solver_timeout=cfg.solver_timeout,
             transaction_count=cfg.transaction_count,
             creation_bytecodes=creation if with_creation else None,
             execution_timeout=cfg.execution_timeout,
+            create_timeout=cfg.create_timeout,
             strategy=cfg.strategy,
             enable_iprof=cfg.enable_iprof,
             plugins=cfg.plugins,
         )
-        report = fire_lasers(self.sym, white_list=modules)
+        report = fire_lasers(self.sym, white_list=modules,
+                             parallel=cfg.parallel_solving)
         if self.contracts:
             report.contract_name = self.contracts[0].name
         self._attach_source_locations(report)

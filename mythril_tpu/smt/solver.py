@@ -14,6 +14,7 @@ degrade-to-no-issue semantics as the reference's solver timeout
 from __future__ import annotations
 
 import random
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -43,17 +44,25 @@ class SolverStatistics:
     time_sec: float = 0.0
     partitioned: int = 0  # queries split into >1 independent cluster
 
+    #: class-level (not a dataclass field — snapshot() builds positionally);
+    #: only the process singleton records, so sharing one lock is fine
+    _lock = threading.Lock()
+
     def record(self, verdict: str, dt: float, cached: bool = False) -> None:
-        self.attempts += 1
-        if verdict == "sat":
-            self.sat += 1
-        elif verdict == "unsat":
-            self.unsat += 1
-        else:
-            self.unknown += 1
-        if cached:
-            self.cache_hits += 1
-        self.time_sec += dt
+        # lock, not bare +=: --parallel-solving runs module threads that
+        # record concurrently, and a torn read-modify-write would leak
+        # counts exactly where the unknown-rate observable matters
+        with self._lock:
+            self.attempts += 1
+            if verdict == "sat":
+                self.sat += 1
+            elif verdict == "unsat":
+                self.unsat += 1
+            else:
+                self.unknown += 1
+            if cached:
+                self.cache_hits += 1
+            self.time_sec += dt
 
     def reset(self) -> None:
         self.attempts = self.sat = self.unsat = self.unknown = 0
@@ -88,6 +97,33 @@ class SolverStatistics:
 
 #: process-wide statistics (the reference uses a singleton too)
 SOLVER_STATS = SolverStatistics()
+
+
+def _dump_unknown(tape: HostTape) -> None:
+    """Residue collection (VERDICT r4 ask #3): with
+    ``MYTHRIL_DUMP_UNKNOWN=<dir>`` every query the search gives up on is
+    serialized for offline analysis — the evidence base for deciding
+    which inverter/refuter extension actually shrinks the unknown rate."""
+    import os
+
+    d = os.environ.get("MYTHRIL_DUMP_UNKNOWN")
+    if not d:
+        return
+    try:
+        import json
+        import uuid
+
+        os.makedirs(d, exist_ok=True)
+        doc = {
+            "nodes": [[nd.op, nd.a, nd.b, hex(nd.imm)]
+                      for nd in tape.nodes],
+            "constraints": [[int(n), bool(s)] for n, s in tape.constraints],
+        }
+        with open(os.path.join(d, f"unknown_{uuid.uuid4().hex[:12]}.json"),
+                  "w") as fh:
+            json.dump(doc, fh)
+    except Exception:  # noqa: BLE001 — diagnostics must never kill a run
+        pass
 
 
 _INTERESTING = (0, 1, 2, 0xFF, 1 << 31, 1 << 128, M256, M256 - 1, 1 << 255)
@@ -326,7 +362,8 @@ def partition_constraints(tape: HostTape) -> List[List[int]]:
 
 
 def _solve_partitioned(tape: HostTape, seed: int, max_iters: int,
-                       base: Optional[Assignment]
+                       base: Optional[Assignment],
+                       deadline: Optional[float] = None
                        ) -> Tuple[str, Optional[Assignment]]:
     """Split the query into independent clusters and solve each with the
     FULL search budget (smaller supports decide in far fewer iterations,
@@ -335,14 +372,16 @@ def _solve_partitioned(tape: HostTape, seed: int, max_iters: int,
     are disjoint, so later solves cannot disturb earlier ones."""
     clusters = partition_constraints(tape)
     if len(clusters) <= 1:
-        out = _solve_tape_inner(tape, seed, max_iters, base)
+        out = _solve_tape_inner(tape, seed, max_iters, base, deadline)
         return ("sat" if out is not None else "unknown"), out
-    SOLVER_STATS.partitioned += 1
+    with SOLVER_STATS._lock:  # parallel-solving threads race this too
+        SOLVER_STATS.partitioned += 1
     asn = base.copy() if base is not None else Assignment()
     for cl in clusters:
         sub = HostTape(nodes=tape.nodes,
                        constraints=[tape.constraints[j] for j in cl])
-        res = _solve_tape_inner(sub, seed, max_iters, base=asn)
+        res = _solve_tape_inner(sub, seed, max_iters, base=asn,
+                                deadline=deadline)
         if res is None:
             # (a cluster over NO free variables can't reach here: a
             # concretely-false closed constraint is proven unsat by
@@ -355,7 +394,7 @@ def _solve_partitioned(tape: HostTape, seed: int, max_iters: int,
     vals = evaluate(tape, asn)
     if all(bool(vals[n]) == s for n, s in tape.constraints):
         return "sat", asn
-    out = _solve_tape_inner(tape, seed, max_iters, base)
+    out = _solve_tape_inner(tape, seed, max_iters, base, deadline)
     return ("sat" if out is not None else "unknown"), out
 
 
@@ -373,16 +412,18 @@ _SOLVE_CACHE: Dict[tuple, Tuple[str, Optional[Assignment]]] = {}
 _SOLVE_CACHE_CAP = 8192
 
 
-def _fingerprint(tape: HostTape, seed: int, max_iters: int) -> tuple:
+def _fingerprint(tape: HostTape, seed: int, max_iters: int,
+                 max_time: Optional[float]) -> tuple:
     return (
         tuple((nd.op, nd.a, nd.b, nd.imm) for nd in tape.nodes),
         tuple((int(n), bool(s)) for n, s in tape.constraints),
-        seed, max_iters,
+        seed, max_iters, max_time,
     )
 
 
 def solve_tape_ex(tape: HostTape, seed: int = 0, max_iters: int = 400,
-                  base: Optional[Assignment] = None
+                  base: Optional[Assignment] = None,
+                  max_time: Optional[float] = None
                   ) -> Tuple[str, Optional[Assignment]]:
     """(verdict, assignment) with verdict in {"sat", "unsat", "unknown"}.
 
@@ -390,13 +431,17 @@ def solve_tape_ex(tape: HostTape, seed: int = 0, max_iters: int = 400,
     a structural refutation pass (proven UNSAT is recorded distinctly from
     search-exhausted UNKNOWN in ``SOLVER_STATS``), then the witness
     search. ``base``-seeded queries skip the cache (the assignment is an
-    input the fingerprint does not cover)."""
+    input the fingerprint does not cover). ``max_time`` is a per-query
+    wall-clock budget in seconds (reference: ``--solver-timeout`` ms ⚠unv)
+    checked between repair iterations; expiry returns unknown, same
+    degrade-to-no-issue semantics as an exhausted iteration budget."""
     from .refute import refute_tape
 
     t0 = time.perf_counter()
+    deadline = None if max_time is None else t0 + max_time
     key = None
     if base is None:
-        key = _fingerprint(tape, seed, max_iters)
+        key = _fingerprint(tape, seed, max_iters, max_time)
         hit = _SOLVE_CACHE.get(key)
         if hit is not None:
             verdict, asn = hit
@@ -407,23 +452,41 @@ def solve_tape_ex(tape: HostTape, seed: int = 0, max_iters: int = 400,
     if refute_tape(tape) is not None:
         verdict, out = "unsat", None
     else:
-        verdict, out = _solve_partitioned(tape, seed, max_iters, base)
+        verdict, out = _solve_partitioned(tape, seed, max_iters, base,
+                                          deadline)
+    if verdict == "unknown":
+        _dump_unknown(tape)
+    if (verdict == "unknown" and deadline is not None
+            and time.perf_counter() >= deadline):
+        # a wall-clock expiry is load-dependent, not a property of the
+        # query — caching it would permanently poison this fingerprint
+        # for re-queries issued after contention subsides
+        key = None
     if key is not None:
         if len(_SOLVE_CACHE) >= _SOLVE_CACHE_CAP:
-            _SOLVE_CACHE.pop(next(iter(_SOLVE_CACHE)))
+            # tolerant eviction: under --parallel-solving two module
+            # threads can race the read-then-pop; losing the race must
+            # not throw (a raised KeyError here would eat the caller
+            # module's whole finding list)
+            try:
+                _SOLVE_CACHE.pop(next(iter(_SOLVE_CACHE)), None)
+            except (StopIteration, RuntimeError):
+                pass
         _SOLVE_CACHE[key] = (verdict, out.copy() if out is not None else None)
     SOLVER_STATS.record(verdict, time.perf_counter() - t0)
     return verdict, out
 
 
 def solve_tape(tape: HostTape, seed: int = 0, max_iters: int = 400,
-               base: Optional[Assignment] = None) -> Optional[Assignment]:
+               base: Optional[Assignment] = None,
+               max_time: Optional[float] = None) -> Optional[Assignment]:
     """Find an assignment satisfying every tape constraint, or None."""
-    return solve_tape_ex(tape, seed, max_iters, base)[1]
+    return solve_tape_ex(tape, seed, max_iters, base, max_time)[1]
 
 
 def _solve_tape_inner(tape: HostTape, seed: int = 0, max_iters: int = 400,
-                      base: Optional[Assignment] = None) -> Optional[Assignment]:
+                      base: Optional[Assignment] = None,
+                      deadline: Optional[float] = None) -> Optional[Assignment]:
     rng = random.Random(seed)
     asn = base.copy() if base is not None else Assignment()
     vals = evaluate(tape, asn)
@@ -449,6 +512,8 @@ def _solve_tape_inner(tape: HostTape, seed: int = 0, max_iters: int = 400,
         return asn
     inv = _Inverter(tape, vals)
     for _ in range(max_iters):
+        if deadline is not None and time.perf_counter() >= deadline:
+            return None  # budget expired mid-search -> unknown
         unsat_idx = [j for j, ok in enumerate(sat) if not ok]
         if not unsat_idx:
             return asn
@@ -475,10 +540,12 @@ def _solve_tape_inner(tape: HostTape, seed: int = 0, max_iters: int = 400,
 class Solver:
     """Reference-shaped front door: add constraints, check, get model."""
 
-    def __init__(self, tape: HostTape, seed: int = 0, max_iters: int = 400):
+    def __init__(self, tape: HostTape, seed: int = 0, max_iters: int = 400,
+                 max_time: Optional[float] = None):
         self.tape = HostTape(nodes=tape.nodes, constraints=list(tape.constraints))
         self.seed = seed
         self.max_iters = max_iters
+        self.max_time = max_time
         self._model: Optional[Assignment] = None
 
     def add(self, node: int, sign: bool = True) -> None:
@@ -486,7 +553,8 @@ class Solver:
 
     def check(self) -> str:
         verdict, self._model = solve_tape_ex(self.tape, self.seed,
-                                             self.max_iters)
+                                             self.max_iters,
+                                             max_time=self.max_time)
         return verdict
 
     def model(self) -> Assignment:

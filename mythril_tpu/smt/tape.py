@@ -160,9 +160,15 @@ class AnnotationSpace:
         return any(tag in tags and t in c for t, tags in self._own.items())
 
 
+# tx.origin IS the attacker EOA: every symbolic transaction originates
+# from the ATTACKER actor (reference: symbolic tx setup constrains origin
+# to the attacker/creator pair ⚠unv, SURVEY §3.2), so a value sink keyed
+# on ORIGIN is attacker-directed — e.g. the config-4 vault's ``sweep()``
+# paying out to tx.origin at call depth 3.
 ATTACKER_KINDS = {
     int(FreeKind.CALLDATA_WORD), int(FreeKind.CALLDATASIZE),
     int(FreeKind.CALLVALUE), int(FreeKind.CALLER),
+    int(FreeKind.ORIGIN),
 }
 
 

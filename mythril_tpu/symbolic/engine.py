@@ -186,11 +186,22 @@ def _append_constraint(sf: SymFrontier, mask, node, sign, pc):
 def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     """SLOAD/SSTORE with (possibly symbolic) keys and values.
 
-    Key matching is syntactic: concrete keys match by limb equality,
-    symbolic keys by tape node id (hash-consing makes structurally equal
-    keccak keys share an id — the analog of the reference's
-    KeccakFunctionManager hash-linking ⚠unv). Distinct node ids are
-    treated as distinct slots; numeric aliasing between them is missed.
+    Key matching: concrete keys match by limb equality, symbolic keys by
+    tape node id (hash-consing makes structurally equal keccak keys share
+    an id — the analog of the reference's KeccakFunctionManager
+    hash-linking ⚠unv), PLUS a numeric alias probe (VERDICT r4 ask #6):
+    a symbolic key whose known-bits domain (propagate.py, persistent
+    ``kb_m``/``kb_v``) is fully determined has a definite numeric value
+    and is DEMOTED to that value — it matches concrete keys and other
+    fully-determined keys numerically, its SSTORE entry is stored
+    concrete, and its SLOAD-miss leaf hash-conses on the value. A write
+    through ``f(x)`` and a read through a structurally different but
+    provably-equal ``g(y)`` therefore connect. Keys the domain cannot
+    fully determine keep node-id matching (assumed-distinct: the same
+    syntactic under-approximation the reference's independent BitVec
+    keys give Z3 before hash-linking resolves them ⚠unv). Nodes not yet
+    reached by a propagation sweep (``>= prop_len``) never demote — their
+    kb rows may hold a recycled lane's stale domains.
     """
     f = sf.base
     key = ci._peek(f, 0)
@@ -204,18 +215,55 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     f = sf.base
 
     in_acct = f.st_acct == f.cur_acct[:, None]
-    conc = (key_sym[:, None] == 0) & (sf.st_key_sym == 0) & jnp.all(
-        f.st_keys == key[:, None, :], axis=-1
-    )
+    # numeric alias probe: definite values for fully-known-bits keys
+    T = sf.tape_op.shape[1]
+    kidx = jnp.clip(key_sym, 0, T - 1)
+    key_kbm = jnp.take_along_axis(sf.kb_m, kidx[:, None, None], axis=1)[:, 0]
+    key_kbv = jnp.take_along_axis(sf.kb_v, kidx[:, None, None], axis=1)[:, 0]
+    key_known = ((key_sym != 0) & (key_sym < sf.prop_len)
+                 & jnp.all(key_kbm == U32(0xFFFFFFFF), axis=-1))
+    key_def = (key_sym == 0) | key_known
+    key_num = jnp.where(key_known[:, None], key_kbv, key).astype(U32)
+    eff_key_sym = jnp.where(key_known, 0, key_sym)  # demoted-to-concrete
+    ent_sym = sf.st_key_sym
+    eidx = jnp.clip(ent_sym, 0, T - 1)
+    ent_kbm = jnp.take_along_axis(sf.kb_m, eidx[:, :, None], axis=1)
+    ent_known = ((ent_sym != 0) & (ent_sym < sf.prop_len[:, None])
+                 & jnp.all(ent_kbm == U32(0xFFFFFFFF), axis=-1))
+    ent_kbv = jnp.take_along_axis(sf.kb_v, eidx[:, :, None], axis=1)
+    ent_def = (ent_sym == 0) | ent_known
+    ent_num = jnp.where(ent_known[:, :, None], ent_kbv,
+                        f.st_keys).astype(U32)
+
+    conc = (key_def[:, None] & ent_def
+            & jnp.all(ent_num == key_num[:, None, :], axis=-1))
     symm = (key_sym[:, None] != 0) & (sf.st_key_sym == key_sym[:, None])
     match = f.st_used & in_acct & (conc | symm)
-    hit = jnp.any(match, axis=1)
+    # a VALUE hit requires a value-bearing entry (st_seq > 0): berlin
+    # warm-tracking (_berlin_gas_post) allocates (key, 0, unwritten)
+    # entries for concrete SLOAD misses, and matching those as hits
+    # would read concrete 0 where the first load of the same slot
+    # produced a symbolic STORAGE leaf — the same slot must keep reading
+    # as that leaf. Seq-0 entries still count for SSTORE slot reuse
+    # below, so a later store overwrites the warm entry in place.
+    match_val = match & (sf.st_seq > 0)
+    hit = jnp.any(match_val, axis=1)
     # dependency tracking: a hit on an entry NOT written this tx is a read
-    # of a prior transaction's write (cache entries only exist via SSTORE)
-    prior_hit = jnp.any(match & ~f.st_written, axis=1)
+    # of a prior transaction's write (entries persist across the boundary
+    # with st_written cleared)
+    prior_hit = jnp.any(match_val & ~f.st_written, axis=1)
     sf = sf.replace(dep_read=sf.dep_read | (m & ~is_store & prior_hit))
-    cur = jnp.sum(jnp.where(match[:, :, None], f.st_vals, 0), axis=1).astype(U32)
-    cur_sym = jnp.sum(jnp.where(match, sf.st_val_sym, 0), axis=1).astype(I32)
+    # LATEST-write matching slot, not a masked sum: the alias probe can
+    # connect an entry written before its key's bits were proven WITH a
+    # concrete entry of the same value — and slot INDEX order does not
+    # track write order once a lower slot is re-written in place, so the
+    # group's max-``st_seq`` entry is the live one (reads and the SSTORE
+    # reuse slot below agree on this policy; stale members stay shadowed)
+    sel = jnp.argmax(jnp.where(match, sf.st_seq, -1), axis=1).astype(I32)
+    cur = jnp.take_along_axis(f.st_vals, sel[:, None, None], axis=1)[:, 0]
+    cur = jnp.where(hit[:, None], cur, 0).astype(U32)
+    cur_sym = jnp.take_along_axis(sf.st_val_sym, sel[:, None], axis=1)[:, 0]
+    cur_sym = jnp.where(hit, cur_sym, 0).astype(I32)
 
     # SLOAD miss -> fresh STORAGE leaf (hash-consed on (account, key), so
     # repeated loads of the same key agree while distinct accounts'
@@ -224,10 +272,12 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     miss_load = m & ~is_store & ~hit
     A = f.acct_used.shape[1]
     if spec.storage:
+        # eff_key_sym/key_num: a demoted (fully-known) key hash-conses on
+        # its VALUE, sharing the leaf a concrete key of that value gets
         sf, leaf = append_node(
             sf, miss_load, int(SymOp.FREE), int(FreeKind.STORAGE),
-            key_sym * A + f.cur_acct,
-            jnp.where((key_sym == 0)[:, None], key, 0).astype(U32),
+            eff_key_sym * A + f.cur_acct,
+            jnp.where((eff_key_sym == 0)[:, None], key_num, 0).astype(U32),
         )
     else:
         leaf = jnp.zeros_like(key_sym)
@@ -236,9 +286,12 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     loaded_sym = jnp.where(hit, cur_sym, leaf)
 
     # SSTORE into matching-or-free slot (shared alloc policy with the
-    # concrete handler)
-    slot_id = jnp.argmax(match, axis=1).astype(I32)
-    widx, overflow = ci.storage_alloc(f, hit, slot_id, m & is_store)
+    # concrete handler); same max-seq slot the read path selects, and
+    # ANY match (incl. a seq-0 warm entry) is reused rather than
+    # duplicated — only the VALUE-hit predicate above is seq-gated
+    slot_id = sel
+    widx, overflow = ci.storage_alloc(f, jnp.any(match, axis=1), slot_id,
+                                      m & is_store)
     # SWC event records: first SSTORE after a RE-ENTERABLE external call
     # (STATICCALL/CREATE can't re-enter mutably), and first SSTORE through
     # a symbolic NON-keccak key (a direct-keccak key is a mapping access;
@@ -251,7 +304,9 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
         sf.tape_op, jnp.clip(key_sym, 0, T - 1)[:, None], axis=1
     )[:, 0]
     key_is_hash = key_op == int(SymOp.KECCAK)
-    first_arb = store_m & (key_sym != 0) & ~key_is_hash & (sf.arb_key_pc < 0)
+    # a demoted key has ONE reachable value on this path — not an
+    # attacker-controlled arbitrary write target (eff, not key_sym)
+    first_arb = store_m & (eff_key_sym != 0) & ~key_is_hash & (sf.arb_key_pc < 0)
     # SLOAD results ride the aux channel to sym_superstep's shared
     # writeback — base.stack/base.sp/stack_sym stay OUT of this claimed
     # handler's cond outputs (same traffic argument as dispatch's
@@ -259,14 +314,16 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     # whole [P,S,8] stack at the boundary every storage superstep)
     return sf.replace(
         base=f.replace(
-            st_keys=ci._write_slot(f.st_keys, widx, key),
+            st_keys=ci._write_slot(f.st_keys, widx, key_num),
             st_vals=ci._write_slot(f.st_vals, widx, val),
             st_used=ci._write_slot(f.st_used, widx, True),
             st_written=ci._write_slot(f.st_written, widx, True),
             st_acct=ci._write_slot(f.st_acct, widx, f.cur_acct),
         ).trap(overflow, Trap.STORAGE_SLOTS),
-        st_key_sym=ci._write_slot(sf.st_key_sym, widx, key_sym),
+        st_key_sym=ci._write_slot(sf.st_key_sym, widx, eff_key_sym),
         st_val_sym=ci._write_slot(sf.st_val_sym, widx, val_sym),
+        st_seq=ci._write_slot(sf.st_seq, widx, sf.st_seq_ctr + 1),
+        st_seq_ctr=sf.st_seq_ctr + store_m.astype(I32),
         sstore_after_call_pc=jnp.where(first_after_call, f.pc, sf.sstore_after_call_pc),
         sstore_ac_cid=jnp.where(first_after_call, f.contract_id, sf.sstore_ac_cid),
         arb_key_node=jnp.where(first_arb, key_sym, sf.arb_key_node),
@@ -776,12 +833,14 @@ def _h_sym_call(sf: SymFrontier, corpus: Corpus, op, m, old_pc,
         fr_callvalue_sym=_fr_set(sf.fr_callvalue_sym, d, sf.callvalue_sym, mi),
         fr_st_val_sym=_fr_set(sf.fr_st_val_sym, d, sf.st_val_sym, mi),
         fr_st_key_sym=_fr_set(sf.fr_st_key_sym, d, sf.st_key_sym, mi),
+        fr_st_seq=_fr_set(sf.fr_st_seq, d, sf.st_seq, mi),
     )
     # precompile outputs land after the common bookkeeping so they can
     # override the pushed-result defaults for their lanes
     return lax.cond(
         jnp.any(pre),
-        lambda s: _apply_precompiles(s, pre, pid, a_off, a_len, r_off, r_len),
+        lambda s: _apply_precompiles(s, pre, pid, a_off, a_len, r_off, r_len,
+                                     spec),
         lambda s: s,
         sf,
     )
@@ -808,7 +867,7 @@ def _be_window_word(buf, start, width, INW: int):
 
 
 def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
-                       r_len) -> SymFrontier:
+                       r_len, spec: SymSpec = SymSpec()) -> SymFrontier:
     """Execute precompile calls 0x1-0x9 for the `pre` lanes.
 
     Reference: ``mythril/laser/ethereum/natives.py`` (⚠unv) — all nine
@@ -897,28 +956,6 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
                 ok[i] = True
         return res, ok
 
-    def _run_ecr(_):
-        return jax.pure_callback(
-            _host_ecr,
-            (jax.ShapeDtypeStruct((P, 32), jnp.uint8),
-             jax.ShapeDtypeStruct((P,), jnp.bool_)),
-            inp, m_ecr,
-        )
-
-    # `if cb_ok` (a trace-time Python bool) keeps the callback custom-call
-    # OUT of the traced program entirely on runtimes that reject it —
-    # even an un-taken cond branch containing it fails axon compilation
-    if cb_ok:
-        ecr_bytes, ecr_ok = lax.cond(
-            jnp.any(m_ecr), _run_ecr,
-            lambda _: (jnp.zeros((P, 32), dtype=jnp.uint8),
-                       jnp.zeros((P,), dtype=jnp.bool_)),
-            0,
-        )
-    else:
-        ecr_bytes = jnp.zeros((P, 32), dtype=jnp.uint8)
-        ecr_ok = jnp.zeros((P,), dtype=jnp.bool_)
-
     # ripemd160 / bn128 / blake2f: one batched host callback (rare path,
     # gated like ecrecover). ok=False = the precompile call itself fails.
     def _host_nat(inp_np, pid_np, alen_np, mask_np):
@@ -926,24 +963,69 @@ def _apply_precompiles(sf: SymFrontier, pre, pid, a_off, a_len, r_off,
 
         return natives_batch(inp_np, pid_np, alen_np, mask_np)
 
-    def _run_nat(_):
-        return jax.pure_callback(
-            _host_nat,
-            (jax.ShapeDtypeStruct((P, 64), jnp.uint8),
-             jax.ShapeDtypeStruct((P,), jnp.int32),
-             jax.ShapeDtypeStruct((P,), jnp.bool_)),
-            inp, pid, a_len, m_host,
-        )
+    def _cb_local(inp_l, m_ecr_l, pid_l, a_len_l, m_host_l):
+        """Both precompile callbacks over a (shard-)local lane block.
 
-    if cb_ok:
-        nat_bytes, nat_len, nat_ok = lax.cond(
-            jnp.any(m_host), _run_nat,
-            lambda _: (jnp.zeros((P, 64), dtype=jnp.uint8),
-                       jnp.zeros((P,), dtype=jnp.int32),
-                       jnp.zeros((P,), dtype=jnp.bool_)),
+        Under shard_map each device round-trips only its own lanes (and
+        the per-shard ``any`` gate skips the host hop entirely on shards
+        with no precompile lane); without a mesh this is the whole
+        frontier, identical to the pre-round-5 single-device behavior.
+        """
+        Pl = inp_l.shape[0]
+
+        def _run_ecr(_):
+            return jax.pure_callback(
+                _host_ecr,
+                (jax.ShapeDtypeStruct((Pl, 32), jnp.uint8),
+                 jax.ShapeDtypeStruct((Pl,), jnp.bool_)),
+                inp_l, m_ecr_l,
+            )
+
+        ecr_b, ecr_k = lax.cond(
+            jnp.any(m_ecr_l), _run_ecr,
+            lambda _: (jnp.zeros((Pl, 32), dtype=jnp.uint8),
+                       jnp.zeros((Pl,), dtype=jnp.bool_)),
             0,
         )
+
+        def _run_nat(_):
+            return jax.pure_callback(
+                _host_nat,
+                (jax.ShapeDtypeStruct((Pl, 64), jnp.uint8),
+                 jax.ShapeDtypeStruct((Pl,), jnp.int32),
+                 jax.ShapeDtypeStruct((Pl,), jnp.bool_)),
+                inp_l, pid_l, a_len_l, m_host_l,
+            )
+
+        nat_b, nat_n, nat_k = lax.cond(
+            jnp.any(m_host_l), _run_nat,
+            lambda _: (jnp.zeros((Pl, 64), dtype=jnp.uint8),
+                       jnp.zeros((Pl,), dtype=jnp.int32),
+                       jnp.zeros((Pl,), dtype=jnp.bool_)),
+            0,
+        )
+        return ecr_b, ecr_k, nat_b, nat_n, nat_k
+
+    # `if cb_ok` (a trace-time Python bool) keeps the callback custom-call
+    # OUT of the traced program entirely on runtimes that reject it —
+    # even an un-taken cond branch containing it fails axon compilation
+    if cb_ok:
+        if spec.mesh is not None:
+            from jax.sharding import PartitionSpec as _PS
+            lane = _PS(spec.lane_axis)
+            lane2 = _PS(spec.lane_axis, None)
+            ecr_bytes, ecr_ok, nat_bytes, nat_len, nat_ok = jax.shard_map(
+                _cb_local, mesh=spec.mesh,
+                in_specs=(lane2, lane, lane, lane, lane),
+                out_specs=(lane2, lane, lane2, lane, lane),
+                check_vma=False,
+            )(inp, m_ecr, pid, a_len, m_host)
+        else:
+            ecr_bytes, ecr_ok, nat_bytes, nat_len, nat_ok = _cb_local(
+                inp, m_ecr, pid, a_len, m_host)
     else:
+        ecr_bytes = jnp.zeros((P, 32), dtype=jnp.uint8)
+        ecr_ok = jnp.zeros((P,), dtype=jnp.bool_)
         nat_bytes = jnp.zeros((P, 64), dtype=jnp.uint8)
         nat_len = jnp.zeros((P,), dtype=jnp.int32)
         nat_ok = jnp.zeros((P,), dtype=jnp.bool_)
@@ -1303,6 +1385,7 @@ def _push_create_frame(sf: SymFrontier, mi, is_c2, slot, sin, off, ln, salt,
         fr_callvalue_sym=_fr_set(sf.fr_callvalue_sym, d, sf.callvalue_sym, mi),
         fr_st_val_sym=_fr_set(sf.fr_st_val_sym, d, sf.st_val_sym, mi),
         fr_st_key_sym=_fr_set(sf.fr_st_key_sym, d, sf.st_key_sym, mi),
+        fr_st_seq=_fr_set(sf.fr_st_seq, d, sf.st_seq, mi),
     )
 
 
@@ -1386,6 +1469,9 @@ def pop_frames(sf: SymFrontier, corpus: Corpus) -> SymFrontier:
     acct_bal = roll(f.acct_bal, _fr_get(f.fr_acct_bal, d))
     st_val_sym = roll(sf.st_val_sym, _fr_get(sf.fr_st_val_sym, d))
     st_key_sym = roll(sf.st_key_sym, _fr_get(sf.fr_st_key_sym, d))
+    # seq rolls back WITH the entries (the counter itself stays monotonic
+    # — gaps are harmless, only relative order matters)
+    st_seq = roll(sf.st_seq, _fr_get(sf.fr_st_seq, d))
     # warm sets roll back with the frame (EIP-2929: a reverted call's
     # access-list growth is undone)
     warm_acct = roll(f.warm_acct, _fr_get(f.fr_warm_acct, d))
@@ -1511,6 +1597,7 @@ def pop_frames(sf: SymFrontier, corpus: Corpus) -> SymFrontier:
         bal_epoch=sf.bal_epoch + fail.astype(I32),
         st_val_sym=st_val_sym,
         st_key_sym=st_key_sym,
+        st_seq=st_seq,
         # only a genuine REVERT (require()-style) feeds SWC-123; callee
         # INVALID/OOG/bad-jump are assert-style failures (SWC-110 territory)
         sub_revert_pc=jnp.where(fail & f.reverted & ~f.error
@@ -2087,7 +2174,7 @@ _TAPE_WRITES = ("tape_op", "tape_a", "tape_b", "tape_imm", "tape_hash",
 _STORAGE_WRITES = (
     "base.st_keys", "base.st_vals", "base.st_used",
     "base.st_written", "base.st_acct", "base.error", "base.err_code",
-    "st_key_sym", "st_val_sym", "dep_read",
+    "st_key_sym", "st_val_sym", "st_seq", "st_seq_ctr", "dep_read",
     "sstore_after_call_pc", "sstore_ac_cid", "arb_key_node", "arb_key_pc",
     "arb_key_cid",
 ) + _TAPE_WRITES

@@ -18,6 +18,7 @@ fresh unconstrained variables rather than wrong values):
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Any
 
 import numpy as np
 import jax.numpy as jnp
@@ -68,6 +69,16 @@ class SymSpec:
     caller: bool = False       # reference default: concrete ATTACKER address
     storage: bool = True       # unknown initial storage -> fresh STORAGE leaves
     block_env: bool = True     # timestamp/number/... symbolic (PredictableVars)
+    # When the frontier's lane axis is sharded over a device mesh, the
+    # precompile host callbacks must round-trip only shard-local lanes —
+    # a bare pure_callback inside pjit gets a {maximal device=0} sharding
+    # and XLA inserts a full gather/rescatter ("Involuntary full
+    # rematerialization") that would serialize every superstep on a pod.
+    # Setting ``mesh`` (a hashable jax.sharding.Mesh; part of the jit
+    # cache key via static spec) routes them through jax.shard_map over
+    # ``lane_axis`` instead. None = single-device path, no shard_map.
+    mesh: Any = None
+    lane_axis: str = "dp"
 
 
 @struct.dataclass
@@ -80,6 +91,13 @@ class SymFrontier:
     retdata_sym: jnp.ndarray  # bool[P] returndata of last call is symbolic
     st_val_sym: jnp.ndarray  # i32[P, K]
     st_key_sym: jnp.ndarray  # i32[P, K] sym id of the key stored in the slot
+    st_seq: jnp.ndarray      # i32[P, K] write sequence number of the entry
+    # (0 = never written). The numeric alias probe can put MULTIPLE
+    # entries in one alias group (a slot written before its key's bits
+    # were proven + a concrete slot of the same value); slot INDEX order
+    # does not track write order once a lower slot is re-written in
+    # place, so reads/writes select the group's max-seq entry instead.
+    st_seq_ctr: jnp.ndarray  # i32[P] per-lane monotonic SSTORE counter
     rv_sym: jnp.ndarray      # i32[P, RD/32] sym ids of the RETURN/REVERT payload
     rv_havoc: jnp.ndarray    # bool[P] RETURN/REVERT payload unknown (claimed
     # symbolic-offset halt) — the caller's returndata havocs on pop
@@ -104,6 +122,7 @@ class SymFrontier:
     fr_caller_sym: jnp.ndarray  # i32[P, D]
     fr_st_val_sym: jnp.ndarray  # i32[P, D, K] storage-overlay snapshots
     fr_st_key_sym: jnp.ndarray  # i32[P, D, K]  (revert rollback)
+    fr_st_seq: jnp.ndarray      # i32[P, D, K]
     sub_revert_pc: jnp.ndarray  # i32[P] pc of the CALL whose callee
     # reverted/failed (-1 = none; SWC-123 RequirementsViolation feed)
     sub_revert_cid: jnp.ndarray  # i32[P] contract owning that CALL site
@@ -257,6 +276,8 @@ def make_sym_frontier(
         retdata_sym=jnp.zeros(P, dtype=bool),
         st_val_sym=z(P, K),
         st_key_sym=z(P, K),
+        st_seq=z(P, K),
+        st_seq_ctr=z(P),
         rv_sym=z(P, L.returndata_bytes // 32),
         rv_havoc=jnp.zeros(P, dtype=bool),
         cd_from_mem=jnp.zeros(P, dtype=bool),
@@ -274,6 +295,7 @@ def make_sym_frontier(
         fr_caller_sym=z(P, D),
         fr_st_val_sym=z(P, D, K),
         fr_st_key_sym=z(P, D, K),
+        fr_st_seq=z(P, D, K),
         sub_revert_pc=jnp.full(P, -1, dtype=I32),
         sub_revert_cid=z(P),
         tape_op=jnp.asarray(t_op),

@@ -1,13 +1,14 @@
-"""Dynamic on-chain loading interface (reference: ``mythril/support/
-loader.py`` + ``mythril/ethereum/interface/rpc`` ⚠unv).
+"""Dynamic on-chain loading (reference: ``mythril/support/loader.py`` +
+``mythril/ethereum/interface/rpc`` ⚠unv).
 
-This environment has ZERO network egress, so there is no live JSON-RPC
-client — the surface is interface-shaped and pluggable: anything with
-``eth_getCode`` / ``eth_getStorageAt`` works (the reference's tests mock
-RPC the same way, SURVEY.md §4 "RPC tests"). Loaded code/storage feed the
-analysis as ordinary bytecode / concrete storage seeds; there is no
+Three client tiers behind one Protocol: :class:`HttpRpcClient` (a real
+``EthJsonRpc``-shaped JSON-RPC-over-HTTP client, loopback-tested since
+this image has zero egress), :class:`FileRpcClient` (the JSON-file mock
+the reference's RPC tests use, SURVEY.md §4), and anything duck-typed
+with ``eth_getCode`` / ``eth_getStorageAt``. Loaded code/storage feed
+the analysis as ordinary bytecode / concrete storage seeds; there is no
 mid-execution dynamic loading (the corpus is device-resident and static
-per run — a deliberate frontier-first divergence).
+per run — a deliberate frontier-first divergence, documented here).
 """
 
 from __future__ import annotations
@@ -45,28 +46,64 @@ class FileRpcClient:
 
 
 class HttpRpcClient:
-    """Minimal JSON-RPC-over-HTTP client (reference: ``EthJsonRpc``
-    ⚠unv). Functional code path; unreachable in this zero-egress image,
-    exercised through the same interface as :class:`FileRpcClient`."""
+    """JSON-RPC-over-HTTP client (reference: ``EthJsonRpc``,
+    ``mythril/ethereum/interface/rpc/client.py`` ⚠unv). stdlib
+    ``urllib`` transport (no requests dependency), bounded retries on
+    transport failure, JSON-RPC error surfacing as :class:`DynLoaderError`.
+    Egress does not exist in this image, so coverage comes from a real
+    loopback HTTP server in ``tests/test_rpc_client.py`` — the same way
+    the reference's RPC tests mock their node (SURVEY.md §4)."""
 
-    def __init__(self, url: str, timeout: float = 10.0):
+    def __init__(self, url: str, timeout: float = 10.0, retries: int = 2):
         self.url = url
         self.timeout = timeout
+        self.retries = retries
         self._id = 0
 
     def _call(self, method: str, params):
         import json
+        import time
+        import urllib.error
         import urllib.request
 
         self._id += 1
-        req = urllib.request.Request(
-            self.url,
-            data=json.dumps({"jsonrpc": "2.0", "id": self._id,
-                             "method": method, "params": params}).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            body = json.load(resp)
+        payload = json.dumps({"jsonrpc": "2.0", "id": self._id,
+                              "method": method, "params": params}).encode()
+        last: Exception = DynLoaderError("unreachable")
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(
+                self.url, data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    body = json.load(resp)
+                break
+            except urllib.error.HTTPError as e:
+                # an HTTP status error IS an answer (4xx/5xx with a body,
+                # often a JSON-RPC error object) — surface it, don't
+                # re-POST the identical payload. 5xx is the one class
+                # worth retrying (transient node trouble).
+                if 500 <= e.code < 600 and attempt < self.retries:
+                    last = e
+                    time.sleep(0.1 * (attempt + 1))
+                    continue
+                detail = ""
+                try:
+                    detail = e.read(512).decode("utf-8", "replace")
+                except Exception:  # noqa: BLE001 — body read is best-effort
+                    pass
+                raise DynLoaderError(
+                    f"rpc http {e.code}: {detail or e.reason}") from e
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                # transport/decoding failure: retry with a short backoff;
+                # a JSON-RPC *error response* below is NOT retried — the
+                # node answered, repeating the question won't change it
+                last = e
+                if attempt < self.retries:
+                    time.sleep(0.1 * (attempt + 1))
+        else:
+            raise DynLoaderError(f"rpc transport failed: {last}") from last
         if "error" in body:
             raise DynLoaderError(f"rpc error: {body['error']}")
         return body["result"]
@@ -76,6 +113,15 @@ class HttpRpcClient:
 
     def eth_getStorageAt(self, address: str, slot: str) -> str:
         return self._call("eth_getStorageAt", [address, slot, "latest"])
+
+    def eth_getBalance(self, address: str) -> str:
+        return self._call("eth_getBalance", [address, "latest"])
+
+    def eth_getTransactionCount(self, address: str) -> str:
+        return self._call("eth_getTransactionCount", [address, "latest"])
+
+    def eth_blockNumber(self) -> str:
+        return self._call("eth_blockNumber", [])
 
 
 def rpc_client_from_uri(uri: str):
@@ -109,3 +155,13 @@ class DynLoader:
         word = self._require().eth_getStorageAt(
             f"0x{address:040x}", f"0x{slot:x}")
         return int(word, 16)
+
+    def read_balance(self, address: int) -> int:
+        """Live balance in wei (reference: ``DynLoader`` balance reads for
+        EtherThief witness checks ⚠unv). Clients without eth_getBalance
+        (the file mock predates it) report zero rather than failing."""
+        client = self._require()
+        get = getattr(client, "eth_getBalance", None)
+        if get is None:
+            return 0
+        return int(get(f"0x{address:040x}"), 16)

@@ -238,3 +238,108 @@ def test_analyze_sol_without_solc_fails_clearly(tmp_path, capsys, monkeypatch):
     with pytest.raises(SystemExit) as ei:
         main(["analyze", "-f", str(sol)])
     assert ei.value.code == 2
+
+
+# --- round-5 reference flag parity (VERDICT r4 ask #7) ---
+
+def test_parser_round5_parity_flags():
+    p = create_parser()
+    args = p.parse_args([
+        "analyze", "-c", "00", "--max-depth", "64",
+        "--call-depth-limit", "3", "--solver-timeout", "5000",
+        "--create-timeout", "30", "--parallel-solving",
+        "--unconstrained-storage", "--statespace-json", "ss.json",
+    ])
+    assert args.max_depth == 64
+    assert args.call_depth_limit == 3
+    assert args.solver_timeout == 5000
+    assert args.create_timeout == 30.0
+    assert args.parallel_solving is True
+    assert args.unconstrained_storage is True
+    assert args.statespace_json == "ss.json"
+
+
+def test_flag_max_depth_overrides_max_steps(capsys):
+    # --max-depth (reference name) wins over the default --max-steps
+    rc, out = run_cli(
+        capsys, "analyze", "-c", KILLABLE, "-t", "1",
+        "--max-depth", "32", "--lanes-per-contract", "4",
+        "--limits-profile", "test",
+        "-m", "AccidentallyKillable", "-o", "json",
+    )
+    assert rc == 0
+    assert any(i["swc-id"] == "106" for i in json.loads(out)["issues"])
+
+
+def test_flag_solver_timeout_and_parallel(capsys):
+    rc, out = run_cli(
+        capsys, "analyze", "-c", KILLABLE, "-t", "1",
+        "--max-steps", "32", "--lanes-per-contract", "4",
+        "--limits-profile", "test", "--solver-timeout", "10000",
+        "--parallel-solving",
+        "-m", "AccidentallyKillable", "-o", "json",
+    )
+    assert rc == 0
+    assert any(i["swc-id"] == "106" for i in json.loads(out)["issues"])
+
+
+def test_flag_storage_conflict_errors(capsys):
+    with pytest.raises(SystemExit) as ei:
+        main(["analyze", "-c", KILLABLE, "--concrete-storage",
+              "--unconstrained-storage"])
+    assert ei.value.code == 2
+
+
+def test_flag_unconstrained_storage(capsys):
+    rc, out = run_cli(
+        capsys, "analyze", "-c", KILLABLE, "-t", "1",
+        "--max-steps", "32", "--lanes-per-contract", "4",
+        "--limits-profile", "test", "--unconstrained-storage",
+        "-m", "AccidentallyKillable", "-o", "json",
+    )
+    assert rc == 0
+    assert any(i["swc-id"] == "106" for i in json.loads(out)["issues"])
+
+
+def test_flag_call_depth_limit_reshapes_limits(capsys):
+    # a different frame cap is a different compiled shape; keep it tiny
+    rc, out = run_cli(
+        capsys, "analyze", "-c", KILLABLE, "-t", "1",
+        "--max-steps", "32", "--lanes-per-contract", "4",
+        "--limits-profile", "test", "--call-depth-limit", "2",
+        "-m", "AccidentallyKillable", "-o", "json",
+    )
+    assert rc == 0
+    assert any(i["swc-id"] == "106" for i in json.loads(out)["issues"])
+
+
+def test_flag_create_timeout_creation_still_completes():
+    ctor = assemble("CALLER", 0, "SSTORE", 0, 0, "RETURN")
+    runtime = assemble(0, "SLOAD", 1, "SSTORE", "STOP")
+    contract = MythrilDisassembler.load_from_bytecode(
+        runtime.hex(), creation_code=ctor.hex(), name="Owned")
+    cfg = MythrilConfig(limits=TEST_LIMITS, spec=SymSpec(storage=False),
+                        transaction_count=1, max_steps=128,
+                        lanes_per_contract=4, create_timeout=300.0)
+    analyzer = MythrilAnalyzer([contract], cfg)
+    analyzer.fire_lasers()
+    # a generous creation budget must not mark the run timed out
+    assert analyzer.sym.timed_out is False
+    assert len(analyzer.sym.tx_contexts) == 2
+
+
+def test_statespace_json_dump(tmp_path, capsys):
+    ss = tmp_path / "statespace.json"
+    rc, _ = run_cli(
+        capsys, "analyze", "-c", KILLABLE, "-t", "1",
+        "--max-steps", "32", "--lanes-per-contract", "4",
+        "--limits-profile", "test", "--statespace-json", str(ss),
+        "-m", "AccidentallyKillable", "-o", "json",
+    )
+    assert rc == 0
+    doc = json.loads(ss.read_text())
+    assert doc["lanes"] == 4
+    assert doc["transactions"] and doc["transactions"][0]["paths"]
+    p0 = doc["transactions"][0]["paths"][0]
+    assert {"contract", "pc", "depth", "halted", "branches"} <= set(p0)
+    assert "instruction_coverage_pct" in doc

@@ -9,14 +9,21 @@ assembler: per-index constant variation keeps every contract distinct
 vulnerable + safe shapes across several SWC classes so detection work is
 representative, not degenerate.
 
-Usage:  python tools/gen_corpus.py OUT_DIR [N]
+Usage:  python tools/gen_corpus.py OUT_DIR [N] [TRIO_BATCH=32]
 Then:   python -m mythril_tpu analyze --corpus OUT_DIR --batch-size 32 ...
+(TRIO_BATCH wires the inter-contract trio's callee addresses for that
+--batch-size; use 6 with default limits for real in-batch call resolution)
 """
 
 from __future__ import annotations
 
 import os
 import sys
+
+# host-side tool: never let the imports below (asm → package __init__ →
+# u256 device tables) initialize a TPU backend — under a wedged axon
+# tunnel that hangs the process before the first file is written
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -89,19 +96,46 @@ def plain_store(i: int) -> bytes:
 MIX = [killable, guarded_killable, add_overflow, checked_add,
        timestamp_gate, origin_auth, branchy_store, plain_store]
 
-
 def main() -> int:
     out_dir = sys.argv[1] if len(sys.argv) > 1 else "corpus_synth"
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    # campaign batch size the inter-contract trio is wired for: the
+    # trio's hardcoded callee addresses are ``contract_address(pos)``,
+    # and a contract's account index inside one compiled batch IS its
+    # position in that batch. For the calls to RESOLVE at analysis time
+    # the whole batch must also fit the frontier account table
+    # (2 + batch_size <= limits.max_accounts, so batch 6 at the default
+    # limits). Mismatched batch sizes stay sound — the calls just hit no
+    # known account and degrade to havoc leaves.
+    trio_batch = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+    trio_base = max(trio_batch - 3, 0)
     os.makedirs(out_dir, exist_ok=True)
+    # config-4 shape (BASELINE configs[3], VERDICT r4 ask #5): one
+    # caller→router→vault trio per 32-contract batch, wired for its
+    # in-batch account indices. Filenames are index-first so the sorted
+    # corpus order load_corpus_dir uses EQUALS generation order.
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+    from config4_fixture import build_system
+
+    trio_codes = [(name, runtime) for name, _, runtime
+                  in build_system(base=trio_base)]
+    n_trio = 0
     for i in range(n):
-        gen = MIX[i % len(MIX)]
-        code = gen(i)
-        with open(os.path.join(out_dir, f"{gen.__name__}_{i:05d}.hex"),
-                  "w") as fh:
+        pos = i % trio_batch
+        if pos >= trio_base:
+            name, code = trio_codes[pos - trio_base]
+            fname = f"c{i:05d}_inter_{name.lower()}.hex"
+            n_trio += 1
+        else:
+            gen = MIX[i % len(MIX)]
+            code = gen(i)
+            fname = f"c{i:05d}_{gen.__name__}.hex"
+        with open(os.path.join(out_dir, fname), "w") as fh:
             fh.write(code.hex())
     print(f"{n} contracts -> {out_dir} "
-          f"({len(MIX)} shapes, per-index constants)")
+          f"({len(MIX)} shapes + {n_trio} inter-contract trio members, "
+          f"per-index constants)")
     return 0
 
 
