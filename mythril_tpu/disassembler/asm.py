@@ -70,6 +70,14 @@ def selector_prologue() -> List[Token]:
     return [0, "CALLDATALOAD", (1 << 224), "SWAP1", "DIV"]
 
 
+def mapping_key(slot: int) -> List[Token]:
+    """Solidity mapping-slot idiom: top-of-stack key ->
+    keccak(key . slot). Shared by the in-repo fixtures (erc20_like,
+    config-4, realworld) so the storage-layout convention lives in ONE
+    place."""
+    return [0, "MSTORE", slot, 32, "MSTORE", 64, 0, "SHA3"]
+
+
 def erc20_like() -> bytes:
     """A hand-written token contract exercising the representative opcode
     mix (dispatcher, keccak mapping keys, storage, branches, arithmetic).
@@ -84,9 +92,7 @@ def erc20_like() -> bytes:
     no-solc stand-in with the same structural profile.
     """
 
-    def mapkey(slot: int) -> List[Token]:
-        # key on stack -> keccak(key . slot): MSTORE key@0, slot@32, SHA3(0,64)
-        return [0, "MSTORE", slot, 32, "MSTORE", 64, 0, "SHA3"]
+    mapkey = mapping_key
 
     return assemble(
         # -- dispatcher --

@@ -31,7 +31,8 @@ any batch-aligned position.
 """
 
 from mythril_tpu.core.frontier import contract_address
-from mythril_tpu.disassembler.asm import assemble, selector_prologue
+from mythril_tpu.disassembler.asm import (assemble, mapping_key,
+                                          selector_prologue)
 
 # selectors (fixed, arbitrary 4-byte ids)
 VAULT_DEPOSIT = 0xD0E30DB0    # deposit()
@@ -46,9 +47,7 @@ CALLER_ATTACK = 0x9E5FAAFC
 GAS = ("push3", 200000)
 
 
-def _mapkey(slot: int):
-    """top-of-stack key -> keccak(key . slot)."""
-    return [0, "MSTORE", slot, 32, "MSTORE", 64, 0, "SHA3"]
+_mapkey = mapping_key  # shared slot convention (disassembler/asm.py)
 
 
 def _sel_word(selector: int) -> int:
