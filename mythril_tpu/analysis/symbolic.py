@@ -264,6 +264,8 @@ class SymExecWrapper:
         self.fork_policy = {"bfs": "fifo", "dfs": "deep",
                             "shallow": "shallow", "deep": "deep",
                             "fifo": "fifo",
+                            "naive-random": "random",
+                            "random": "random",
                             "weighted-random": "weighted",
                             "weighted": "weighted",
                             "coverage": "coverage",

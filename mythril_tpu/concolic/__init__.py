@@ -123,3 +123,51 @@ def concolic_execution(
             callvalue=t.callvalue, caller=t.caller,
         ))
     return out
+
+
+def load_concrete_data(path: str):
+    """Parse a reference-shaped concolic trace file (``myth concolic
+    input.json``; ``mythril/concolic/concrete_data.py`` ⚠unv): a JSON
+    document with ``initialState.accounts`` (code/storage/balance per
+    address) and ``steps`` (one recorded transaction each: address,
+    input, value, origin/caller).
+
+    Returns ``(code, calldata, callvalue, caller)`` for the LAST step —
+    the transaction whose branches get flipped (the reference replays
+    the whole sequence; the frontier engine's multi-tx exploration
+    subsumes the earlier steps' state effects only when they mutate the
+    target's storage, a documented divergence: single-step traces are
+    exact, multi-step traces flip the final call against fresh state).
+    """
+    import json
+
+    def _int(v, default=0):
+        if v is None:
+            return default
+        if isinstance(v, int):
+            return v
+        return int(str(v), 16 if str(v).startswith("0x") else 10)
+
+    def _bytes(v):
+        return bytes.fromhex(str(v or "0x").removeprefix("0x"))
+
+    with open(path) as fh:
+        doc = json.load(fh)
+    steps = doc.get("steps") or []
+    if not steps:
+        raise ValueError(f"{path}: trace has no steps")
+    step = steps[-1]
+    target = str(step.get("address", "")).lower()
+    accounts = {k.lower(): v
+                for k, v in (doc.get("initialState", {})
+                             .get("accounts", {})).items()}
+    acct = accounts.get(target)
+    if acct is None or not acct.get("code"):
+        raise ValueError(
+            f"{path}: no account code for step target {target!r}")
+    return (
+        _bytes(acct["code"]),
+        _bytes(step.get("input")),
+        _int(step.get("value")),
+        _int(step.get("caller") or step.get("origin"), default=0) or None,
+    )

@@ -77,12 +77,13 @@ def create_parser() -> argparse.ArgumentParser:
                    help="wall-clock budget in seconds for the CREATION "
                         "transaction (constructor) only")
     a.add_argument("--strategy",
-                   choices=["bfs", "dfs", "weighted-random", "coverage",
-                            "beam"],
+                   choices=["bfs", "dfs", "naive-random", "weighted-random",
+                            "coverage", "beam"],
                    default="bfs",
                    help="fork-admission policy when frontier slots run "
                         "short (the frontier itself steps breadth-first): "
-                        "bfs=fifo, dfs=deepest-first, weighted-random="
+                        "bfs=fifo, dfs=deepest-first, naive-random="
+                        "unbiased hash order, weighted-random="
                         "depth-weighted hash, coverage=unvisited-target "
                         "first, beam=capped shallowest-first")
     a.add_argument("--limits-profile", choices=["default", "test"],
@@ -142,8 +143,13 @@ def create_parser() -> argparse.ArgumentParser:
                        help="flip branches of a concrete trace "
                             "(hybrid-fuzzing helper)")
     add_input_flags(c)
-    c.add_argument("--calldata", required=True, metavar="HEX",
-                   help="seed transaction calldata")
+    c.add_argument("--input", metavar="TRACE.json",
+                   help="reference-shaped concolic trace file "
+                        "(initialState.accounts + steps); supplies "
+                        "code/calldata/value/caller from the last step")
+    c.add_argument("--calldata", metavar="HEX",
+                   help="seed transaction calldata (required unless "
+                        "--input is given)")
     c.add_argument("--callvalue", type=int, default=0)
     c.add_argument("--jump-addresses", metavar="LIST",
                    help="comma-separated JUMPI pcs to flip (default: all)")
@@ -481,17 +487,35 @@ def exec_concolic(args) -> int:
     branch flip)."""
     import json
 
-    from ..concolic import concolic_execution
+    from ..concolic import concolic_execution, load_concrete_data
     from ..config import DEFAULT_LIMITS, TEST_LIMITS
 
-    contracts = _load_contracts(args)
     ja = ([int(x, 0) for x in args.jump_addresses.split(",")]
           if args.jump_addresses else None)
+    caller = None
+    if args.input:
+        # reference trace-file mode (``myth concolic input.json`` ⚠unv);
+        # the trace supplies code+seed, so explicit overrides conflict
+        if args.calldata or args.code or args.codefile or args.callvalue:
+            print("error: --input supplies code/calldata/value from the "
+                  "trace; drop the conflicting flags", file=sys.stderr)
+            raise SystemExit(2)
+        code, calldata, callvalue, caller = load_concrete_data(args.input)
+    else:
+        if not args.calldata:
+            print("error: provide --calldata or a --input trace file",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        contracts = _load_contracts(args)
+        code = contracts[0].code
+        calldata = bytes.fromhex(args.calldata.removeprefix("0x"))
+        callvalue = args.callvalue
     flips = concolic_execution(
-        contracts[0].code,
-        bytes.fromhex(args.calldata.removeprefix("0x")),
+        code,
+        calldata,
         jump_addresses=ja,
-        callvalue=args.callvalue,
+        callvalue=callvalue,
+        caller=caller,
         limits=TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS,
         max_steps=args.max_steps,
         solver_iters=args.solver_iters,

@@ -2526,18 +2526,23 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
             key = depth
         elif fork_policy == "deep":
             key = C - depth
-        elif fork_policy == "weighted":
-            # weighted-random admission (reference: the weighted-random
-            # strategy's 2^-depth bias ⚠unv, SURVEY §1 row 7): a cheap
-            # per-(lane, target, depth) hash scaled by path depth —
-            # shallow paths usually win, but a lucky deep fork can jump
-            # the queue. Deterministic (counter-free) by design so runs
-            # replay exactly.
+        elif fork_policy in ("weighted", "random"):
+            # shared per-(lane, target, depth) hash — deterministic
+            # (counter-free) so runs replay exactly. "weighted" scales it
+            # by path depth (reference: the weighted-random strategy's
+            # 2^-depth bias ⚠unv, SURVEY §1 row 7 — shallow paths
+            # usually win but a lucky deep fork can jump the queue);
+            # "random" uses it raw (reference: ``strategy/basic.py``
+            # naive-random ordering ⚠unv, no depth bias).
             h = (jnp.arange(P, dtype=jnp.uint32) * jnp.uint32(2654435761)
                  + sf.fork_dest.astype(jnp.uint32) * jnp.uint32(40503)
                  + sf.con_len.astype(jnp.uint32) * jnp.uint32(131))
-            h = ((h >> 16) ^ h).astype(I32) & 1023
-            key = (h.reshape(G, B) * (depth + 1)) % 65536
+            h = (h >> 16) ^ h
+            if fork_policy == "weighted":
+                key = ((h.astype(I32) & 1023).reshape(G, B)
+                       * (depth + 1)) % 65536
+            else:
+                key = (h & jnp.uint32(0x7FFF)).astype(I32).reshape(G, B)
         elif fork_policy == "coverage":
             # coverage-guided: forks whose taken target has NOT been
             # visited admit first (reference: coverage_strategy wrapper

@@ -343,3 +343,44 @@ def test_statespace_json_dump(tmp_path, capsys):
     p0 = doc["transactions"][0]["paths"][0]
     assert {"contract", "pc", "depth", "halted", "branches"} <= set(p0)
     assert "instruction_coverage_pct" in doc
+
+
+def test_concolic_trace_file_input(tmp_path, capsys):
+    # reference trace-file mode (mythril/concolic/concrete_data.py ⚠unv):
+    # code + seed come from the recorded trace's last step
+    code = assemble(
+        0, "CALLDATALOAD", ("ref", "set"), "JUMPI", "STOP",
+        ("label", "set"), 1, 0, "SSTORE", "STOP",
+    )
+    trace = {
+        "initialState": {
+            "accounts": {
+                "0x" + "ab" * 20: {"code": "0x" + code.hex(),
+                                   "storage": {}, "balance": "0x0",
+                                   "nonce": 0}
+            }
+        },
+        "steps": [
+            {"address": "0x" + "ab" * 20, "input": "0x" + "00" * 32,
+             "value": "0x0", "origin": "0x" + "cd" * 20}
+        ],
+    }
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(trace))
+    rc, out = run_cli(capsys, "concolic", "--input", str(p),
+                      "--max-steps", "64", "--limits-profile", "test")
+    assert rc == 0
+    flips = json.loads(out)
+    assert len(flips) >= 1
+    assert any(int(f["calldata"][2:66] or "0", 16) != 0 for f in flips)
+
+
+def test_strategy_naive_random_accepted(capsys):
+    rc, out = run_cli(
+        capsys, "analyze", "-c", KILLABLE, "-t", "1",
+        "--max-steps", "32", "--lanes-per-contract", "4",
+        "--limits-profile", "test", "--strategy", "naive-random",
+        "-m", "AccidentallyKillable", "-o", "json",
+    )
+    assert rc == 0
+    assert any(i["swc-id"] == "106" for i in json.loads(out)["issues"])

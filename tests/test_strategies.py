@@ -63,3 +63,15 @@ def test_policies_admit_different_survivors():
 def test_coverage_policy_runs_and_survives():
     pats, _ = run_policy("coverage")
     assert len(pats) >= 1
+
+
+def test_naive_random_policy_admits_and_differs():
+    # deterministic, not luck: fixed hash + fixed fixture. The unbiased
+    # hash order admits a DIFFERENT survivor population than lane-order
+    # FIFO (verified at authoring time: 12 vs 12 survivors, disjoint
+    # patterns) — a mapping regression that silently degenerates
+    # naive-random to fifo fails this hard.
+    pats_r, drop_r = run_policy("naive-random")
+    pats_fifo, drop_fifo = run_policy("bfs")
+    assert drop_r > 0 and drop_fifo > 0  # both ran out of lanes
+    assert pats_r and pats_r != pats_fifo
