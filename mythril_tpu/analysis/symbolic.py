@@ -229,6 +229,7 @@ class SymExecWrapper:
         self,
         bytecodes: Sequence[bytes],
         contract_names: Optional[Sequence[str]] = None,
+        contract_addrs: Optional[Sequence[int]] = None,
         limits: LimitsConfig = DEFAULT_LIMITS,
         spec: SymSpec = SymSpec(),
         lanes_per_contract: int = 64,
@@ -312,6 +313,8 @@ class SymExecWrapper:
         active[::lanes_per_contract] = True  # one seed lane per contract
         sf = make_sym_frontier(
             P, limits, contract_id=cid0, active=active, n_contracts=C,
+            contract_addrs=(list(contract_addrs) if contract_addrs is not None
+                            else None),
             caller=CREATOR_ADDRESS if with_creation else ATTACKER_ADDRESS,
         )
         if with_creation:

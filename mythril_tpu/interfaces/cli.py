@@ -205,12 +205,40 @@ def _load_contracts(args):
         from ..utils.loader import DynLoader, rpc_client_from_uri
 
         dl = DynLoader(rpc_client_from_uri(args.rpc))
-        code = dl.dynld(int(args.address, 16))
+        target_addr = int(args.address, 16)
+        code = dl.dynld(target_addr)
         if not code:
             print(f"error: no code at {args.address}", file=sys.stderr)
             raise SystemExit(2)
-        return [MythrilDisassembler.load_from_bytecode(
-            code.hex(), name=args.address)]
+        target = MythrilDisassembler.load_from_bytecode(
+            code.hex(), name=args.address)
+        target.address = target_addr
+        out = [target]
+        # dynamic loading of statically-referenced callees (pre-pass —
+        # see DynLoader.prefetch_callees): their code joins the corpus
+        # under their REAL addresses so hardcoded cross-contract calls
+        # resolve instead of degrading to havoc. The prefetch is capped
+        # to the frontier account table (2 reserved slots + target +
+        # callees must fit max_accounts, or make_frontier falls to the
+        # own-contract-only layout and NOTHING cross-contract resolves),
+        # and a self-referencing PUSH20 must not duplicate the target.
+        from ..config import DEFAULT_LIMITS, TEST_LIMITS
+
+        A = (TEST_LIMITS if getattr(args, "limits_profile", None) == "test"
+             else DEFAULT_LIMITS).max_accounts
+        room = max(0, A - 2 - 1)
+        for addr, callee in dl.prefetch_callees(code, limit=room,
+                                                exclude=(target_addr,)):
+            c = MythrilDisassembler.load_from_bytecode(
+                callee.hex(), name=f"0x{addr:040x}")
+            c.address = addr
+            out.append(c)
+            print(f"dynld: loaded callee 0x{addr:040x} "
+                  f"({len(callee)} bytes)", file=sys.stderr)
+        if room == 0:
+            print("dynld: account table too small for callee prefetch "
+                  f"(max_accounts={A})", file=sys.stderr)
+        return out
     if getattr(args, "artifact", None):
         from ..solidity import get_contracts_from_standard_json
 

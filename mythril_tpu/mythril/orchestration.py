@@ -61,6 +61,11 @@ class EVMContract:
     code: bytes
     creation_code: Optional[bytes] = None
     name: str = "MAIN"
+    #: on-chain address (``analyze -a`` / dynld prefetch): when set, the
+    #: frontier account table registers THIS address for the contract so
+    #: hardcoded cross-contract calls resolve against the real chain
+    #: layout instead of the synthetic contract_address(i) defaults
+    address: Optional[int] = None
     _disassembly: Optional[Disassembly] = field(default=None, repr=False)
 
     @property
@@ -131,9 +136,21 @@ class MythrilAnalyzer:
             # contracts without creation code deploy via an empty-effect
             # constructor (immediate RETURN) so the batch stays uniform
             creation = [c if c is not None else b"\x00" for c in creation]
+        # getattr, not attribute access: SolidityContract duck-types
+        # code/creation_code/name only and carries no address field
+        addrs = None
+        if any(getattr(c, "address", None) is not None
+               for c in self.contracts):
+            from ..core.frontier import contract_address
+
+            addrs = [getattr(c, "address", None)
+                     if getattr(c, "address", None) is not None
+                     else contract_address(i)
+                     for i, c in enumerate(self.contracts)]
         self.sym = SymExecWrapper(
             [c.code for c in self.contracts],
             contract_names=[c.name for c in self.contracts],
+            contract_addrs=addrs,
             limits=cfg.resolved_limits(),
             spec=cfg.spec,
             lanes_per_contract=cfg.lanes_per_contract,

@@ -156,6 +156,48 @@ class DynLoader:
             f"0x{address:040x}", f"0x{slot:x}")
         return int(word, 16)
 
+    def prefetch_callees(self, code: bytes, limit: int = 4, exclude=()):
+        """Dynamic loading of statically-referenced callees (reference:
+        ``DynLoader.dynld`` resolving CALL targets mid-execution ⚠unv,
+        SURVEY §3.4). The frontier's corpus is compiled-in and static per
+        run, so loading happens as a PRE-PASS instead of mid-execution:
+        scan the target's PUSH20 immediates — the solc idiom for
+        hardcoded contract references (and the EIP-1167 embedded
+        implementation) — and fetch code for each distinct plausible
+        address. Returns ``[(address, code)]`` for the ones that ARE
+        contracts; everything else (EOAs, unknown addresses) is skipped
+        and those calls degrade to the sound havoc path exactly as
+        before. Documented divergence: targets computed at runtime
+        (storage-loaded proxy slots) are not discovered by this pass.
+        """
+        from ..disassembler.disassembly import Disassembly
+
+        out, seen = [], set()
+        # bound total ROUND TRIPS, not just successes: linear-sweep
+        # disassembly decodes metadata/data sections too, and each
+        # garbage PUSH20 would otherwise cost a full (possibly slow)
+        # eth_getCode probe that returns nothing
+        attempts_left = 4 * limit
+        for ins in Disassembly(code).instruction_list:
+            if ins.name != "PUSH20":
+                continue
+            addr = ins.arg_int
+            if not addr or addr in seen or addr in (exclude or ()):
+                continue
+            seen.add(addr)
+            if attempts_left <= 0:
+                break
+            attempts_left -= 1
+            try:
+                callee = self.dynld(addr)
+            except DynLoaderError:
+                continue
+            if callee:
+                out.append((addr, callee))
+                if len(out) >= limit:
+                    break
+        return out
+
     def read_balance(self, address: int) -> int:
         """Live balance in wei (reference: ``DynLoader`` balance reads for
         EtherThief witness checks ⚠unv). Clients without eth_getBalance

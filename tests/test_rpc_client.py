@@ -24,6 +24,7 @@ class _Node(BaseHTTPRequestHandler):
 
     fail_first = 0      # 500-error this many requests before answering
     seen = None         # list collecting parsed request payloads
+    codes = None        # optional {addr_lower: hexcode} per-address map
 
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
         cls = type(self)
@@ -39,7 +40,8 @@ class _Node(BaseHTTPRequestHandler):
             return
         method, params = body["method"], body["params"]
         if method == "eth_getCode":
-            result = CODE
+            result = (cls.codes.get(params[0].lower(), "0x")
+                      if cls.codes is not None else CODE)
         elif method == "eth_getStorageAt":
             result = SLOT0 if int(params[1], 16) == 0 else "0x0"
         elif method == "eth_getBalance":
@@ -71,6 +73,7 @@ class _Node(BaseHTTPRequestHandler):
 def node():
     _Node.fail_first = 0
     _Node.seen = []
+    _Node.codes = None
     srv = ThreadingHTTPServer(("127.0.0.1", 0), _Node)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -162,3 +165,83 @@ def test_analyze_address_over_http(node, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert json.loads(out)["success"] is True
+
+
+def test_prefetch_callees_scans_push20():
+    from mythril_tpu.disassembler.asm import assemble
+
+    callee_addr = int("cd" * 20, 16)
+    target = assemble(
+        0, 0, 0, 0, 0, ("push20", callee_addr), ("push2", 50000),
+        "CALL", "POP", "STOP",
+    )
+    callee = assemble(5, 9, "SSTORE", "STOP")
+
+    class MockClient:
+        def eth_getCode(self, address):
+            if int(address, 16) == callee_addr:
+                return "0x" + callee.hex()
+            return "0x"
+
+        def eth_getStorageAt(self, address, slot):
+            return "0x0"
+
+    dl = DynLoader(MockClient())
+    got = dl.prefetch_callees(target)
+    assert got == [(callee_addr, callee)]
+
+
+def test_analyze_address_prefetches_callees(node, capsys, tmp_path):
+    """analyze -a pulls the target AND its hardcoded callee; the callee
+    joins the corpus under its REAL address, observable in the
+    statespace dump's per-contract instruction coverage."""
+    from mythril_tpu.disassembler.asm import assemble
+    from mythril_tpu.interfaces.cli import main
+
+    callee_addr = int("cd" * 20, 16)
+    target = assemble(
+        0, 0, 0, 0, 0, ("push20", callee_addr), ("push2", 50000),
+        "CALL", "POP", "STOP",
+    )
+    callee = assemble(5, 9, "SSTORE", "STOP")
+    _Node.codes = {"0x" + "ab" * 20: "0x" + target.hex(),
+                   "0x" + "cd" * 20: "0x" + callee.hex()}
+    ss = tmp_path / "ss.json"
+    rc = main(["analyze", "-a", "0x" + "ab" * 20, "--rpc", node,
+               "-t", "1", "--max-steps", "32", "--lanes-per-contract", "4",
+               "--limits-profile", "test", "--statespace-json", str(ss),
+               "-o", "json"])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "dynld: loaded callee 0x" + "cd" * 20 in err
+    cov = json.loads(ss.read_text())["instruction_coverage_pct"]
+    assert len(cov) == 2  # target + prefetched callee both in the corpus
+
+
+def test_prefetch_excludes_target_and_bounds_attempts():
+    from mythril_tpu.disassembler.asm import assemble
+
+    self_addr = int("ab" * 20, 16)
+    callee_addr = int("cd" * 20, 16)
+    # self-reference + callee + a pile of garbage address constants
+    toks = [("push20", self_addr), "POP", ("push20", callee_addr), "POP"]
+    for k in range(40):
+        toks += [("push20", 0x1000 + k), "POP"]
+    target = assemble(*toks, "STOP")
+    callee = assemble("STOP")
+    probes = []
+
+    class MockClient:
+        def eth_getCode(self, address):
+            probes.append(address)
+            return "0x" + callee.hex() if int(address, 16) == callee_addr \
+                else "0x"
+
+        def eth_getStorageAt(self, address, slot):
+            return "0x0"
+
+    dl = DynLoader(MockClient())
+    got = dl.prefetch_callees(target, limit=2, exclude=(self_addr,))
+    assert got == [(callee_addr, callee)]       # self-ref never fetched
+    assert all(int(a, 16) != self_addr for a in probes)
+    assert len(probes) <= 8                      # 4×limit round-trip bound
