@@ -132,6 +132,10 @@ def create_parser() -> argparse.ArgumentParser:
     a.add_argument("-a", "--address", metavar="ADDRESS",
                    help="analyze the on-chain contract at ADDRESS "
                         "(requires --rpc)")
+    a.add_argument("--no-onchain-callees", action="store_true",
+                   help="with -a: skip the dynld pre-pass that fetches "
+                        "code for the target's hardcoded callee "
+                        "addresses (their calls then havoc soundly)")
     a.add_argument("--rpc", metavar="URI",
                    help="JSON-RPC endpoint; 'file:PATH' uses a JSON mock "
                         "({addr: {code, storage}})")
@@ -195,6 +199,23 @@ def create_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _limits_for(args):
+    """THE limits-resolution for a parsed argv — every consumer (analyze,
+    campaign, the dynld prefetch cap) must share this one derivation, or
+    a cap computed from a stale copy can desync from the real account
+    table and silently disable cross-contract resolution."""
+    import dataclasses
+
+    from ..config import DEFAULT_LIMITS, TEST_LIMITS
+
+    limits = (TEST_LIMITS if getattr(args, "limits_profile", None) == "test"
+              else DEFAULT_LIMITS)
+    if getattr(args, "call_depth_limit", None) is not None:
+        limits = dataclasses.replace(limits,
+                                     call_depth=args.call_depth_limit)
+    return limits
+
+
 def _load_contracts(args):
     from ..mythril import MythrilDisassembler
 
@@ -214,6 +235,8 @@ def _load_contracts(args):
             code.hex(), name=args.address)
         target.address = target_addr
         out = [target]
+        if getattr(args, "no_onchain_callees", False):
+            return out
         # dynamic loading of statically-referenced callees (pre-pass —
         # see DynLoader.prefetch_callees): their code joins the corpus
         # under their REAL addresses so hardcoded cross-contract calls
@@ -222,10 +245,7 @@ def _load_contracts(args):
         # callees must fit max_accounts, or make_frontier falls to the
         # own-contract-only layout and NOTHING cross-contract resolves),
         # and a self-referencing PUSH20 must not duplicate the target.
-        from ..config import DEFAULT_LIMITS, TEST_LIMITS
-
-        A = (TEST_LIMITS if getattr(args, "limits_profile", None) == "test"
-             else DEFAULT_LIMITS).max_accounts
+        A = _limits_for(args).max_accounts
         room = max(0, A - 2 - 1)
         for addr, callee in dl.prefetch_callees(code, limit=room,
                                                 exclude=(target_addr,)):
@@ -303,13 +323,8 @@ def exec_analyze(args) -> int:
 
             contracts[0] = dataclasses.replace(
                 contracts[0], creation_code=_to_bytes(fh.read()))
-    from ..config import DEFAULT_LIMITS, TEST_LIMITS
-
-    limits = TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS
-    if args.call_depth_limit is not None:
-        limits = dataclasses.replace(limits, call_depth=args.call_depth_limit)
     cfg = MythrilConfig(
-        limits=limits,
+        limits=_limits_for(args),
         transaction_count=args.transaction_count,
         # --max-depth is the reference name for the per-path depth budget;
         # on the breadth-first frontier that IS the superstep budget
@@ -388,11 +403,8 @@ def _exec_campaign(args) -> int:
     """Corpus campaign: BASELINE configs 2-3 (SURVEY §6)."""
     import json
 
-    from ..config import DEFAULT_LIMITS, TEST_LIMITS
     from ..mythril.campaign import CorpusCampaign, load_corpus_dir
     from ..symbolic import SymSpec
-
-    import dataclasses
 
     for flag, val in (("--create-timeout", args.create_timeout),
                       ("--statespace-json", args.statespace_json)):
@@ -401,14 +413,11 @@ def _exec_campaign(args) -> int:
                   file=sys.stderr)
     contracts = load_corpus_dir(args.corpus)
     num_hosts, host_index = _resolve_hosts(args)
-    limits = TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS
-    if args.call_depth_limit is not None:
-        limits = dataclasses.replace(limits, call_depth=args.call_depth_limit)
     campaign = CorpusCampaign(
         contracts,
         batch_size=args.batch_size,
         lanes_per_contract=args.lanes_per_contract,
-        limits=limits,
+        limits=_limits_for(args),
         spec=SymSpec(storage=not args.concrete_storage),
         max_steps=(args.max_depth if args.max_depth is not None
                    else args.max_steps),
@@ -516,7 +525,6 @@ def exec_concolic(args) -> int:
     import json
 
     from ..concolic import concolic_execution, load_concrete_data
-    from ..config import DEFAULT_LIMITS, TEST_LIMITS
 
     ja = ([int(x, 0) for x in args.jump_addresses.split(",")]
           if args.jump_addresses else None)
@@ -544,7 +552,7 @@ def exec_concolic(args) -> int:
         jump_addresses=ja,
         callvalue=callvalue,
         caller=caller,
-        limits=TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS,
+        limits=_limits_for(args),
         max_steps=args.max_steps,
         solver_iters=args.solver_iters,
     )
@@ -598,13 +606,12 @@ def exec_safe_functions(args) -> int:
     """Reference: ``myth safe-functions`` — functions in which no issue
     was detected (⚠unv). Coverage warnings are printed alongside: a
     function is only as safe as the exploration was complete."""
-    from ..config import DEFAULT_LIMITS, TEST_LIMITS
     from ..mythril import MythrilAnalyzer, MythrilConfig
     from ..utils.signatures import SignatureDB
 
     contracts = _load_contracts(args)
     cfg = MythrilConfig(
-        limits=TEST_LIMITS if args.limits_profile == "test" else DEFAULT_LIMITS,
+        limits=_limits_for(args),
         transaction_count=args.transaction_count,
         max_steps=args.max_steps,
         lanes_per_contract=args.lanes_per_contract,

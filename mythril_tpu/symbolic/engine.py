@@ -215,25 +215,35 @@ def _h_sym_storage(sf: SymFrontier, spec: SymSpec, op, m) -> SymFrontier:
     f = sf.base
 
     in_acct = f.st_acct == f.cur_acct[:, None]
-    # numeric alias probe: definite values for fully-known-bits keys
-    T = sf.tape_op.shape[1]
-    kidx = jnp.clip(key_sym, 0, T - 1)
-    key_kbm = jnp.take_along_axis(sf.kb_m, kidx[:, None, None], axis=1)[:, 0]
-    key_kbv = jnp.take_along_axis(sf.kb_v, kidx[:, None, None], axis=1)[:, 0]
-    key_known = ((key_sym != 0) & (key_sym < sf.prop_len)
-                 & jnp.all(key_kbm == U32(0xFFFFFFFF), axis=-1))
+    # numeric alias probe: definite values for fully-known-bits keys.
+    # spec.alias_probe is a trace-time bool — False compiles the kb
+    # gathers out entirely and the match reduces to the syntactic form.
+    if spec.alias_probe:
+        T = sf.tape_op.shape[1]
+        kidx = jnp.clip(key_sym, 0, T - 1)
+        key_kbm = jnp.take_along_axis(sf.kb_m, kidx[:, None, None],
+                                      axis=1)[:, 0]
+        key_kbv = jnp.take_along_axis(sf.kb_v, kidx[:, None, None],
+                                      axis=1)[:, 0]
+        key_known = ((key_sym != 0) & (key_sym < sf.prop_len)
+                     & jnp.all(key_kbm == U32(0xFFFFFFFF), axis=-1))
+        key_num = jnp.where(key_known[:, None], key_kbv, key).astype(U32)
+        ent_sym = sf.st_key_sym
+        eidx = jnp.clip(ent_sym, 0, T - 1)
+        ent_kbm = jnp.take_along_axis(sf.kb_m, eidx[:, :, None], axis=1)
+        ent_known = ((ent_sym != 0) & (ent_sym < sf.prop_len[:, None])
+                     & jnp.all(ent_kbm == U32(0xFFFFFFFF), axis=-1))
+        ent_kbv = jnp.take_along_axis(sf.kb_v, eidx[:, :, None], axis=1)
+        ent_num = jnp.where(ent_known[:, :, None], ent_kbv,
+                            f.st_keys).astype(U32)
+    else:
+        key_known = jnp.zeros_like(key_sym, dtype=bool)
+        key_num = key
+        ent_known = jnp.zeros_like(sf.st_key_sym, dtype=bool)
+        ent_num = f.st_keys
     key_def = (key_sym == 0) | key_known
-    key_num = jnp.where(key_known[:, None], key_kbv, key).astype(U32)
     eff_key_sym = jnp.where(key_known, 0, key_sym)  # demoted-to-concrete
-    ent_sym = sf.st_key_sym
-    eidx = jnp.clip(ent_sym, 0, T - 1)
-    ent_kbm = jnp.take_along_axis(sf.kb_m, eidx[:, :, None], axis=1)
-    ent_known = ((ent_sym != 0) & (ent_sym < sf.prop_len[:, None])
-                 & jnp.all(ent_kbm == U32(0xFFFFFFFF), axis=-1))
-    ent_kbv = jnp.take_along_axis(sf.kb_v, eidx[:, :, None], axis=1)
-    ent_def = (ent_sym == 0) | ent_known
-    ent_num = jnp.where(ent_known[:, :, None], ent_kbv,
-                        f.st_keys).astype(U32)
+    ent_def = (sf.st_key_sym == 0) | ent_known
 
     conc = (key_def[:, None] & ent_def
             & jnp.all(ent_num == key_num[:, None, :], axis=-1))

@@ -79,6 +79,13 @@ class SymSpec:
     # ``lane_axis`` instead. None = single-device path, no shard_map.
     mesh: Any = None
     lane_axis: str = "dp"
+    # numeric storage-alias probe (VERDICT r4 ask #6): demote symbolic
+    # keys with fully-known bits to their value at SSTORE/SLOAD so
+    # provably-equal keys connect. Trace-time static: False compiles the
+    # probe out entirely (~0-15% cost on storage-heavy CPU workloads,
+    # noise-limited — see docs/perf-round5-cpu-ab.md; the soundness win
+    # is the default, the flag exists for perf runs and A/B measurement).
+    alias_probe: bool = True
 
 
 @struct.dataclass

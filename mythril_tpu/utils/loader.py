@@ -13,7 +13,10 @@ per run — a deliberate frontier-first divergence, documented here).
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Protocol
+
+log = logging.getLogger(__name__)
 
 
 class RpcClient(Protocol):
@@ -177,7 +180,8 @@ class DynLoader:
         # disassembly decodes metadata/data sections too, and each
         # garbage PUSH20 would otherwise cost a full (possibly slow)
         # eth_getCode probe that returns nothing
-        attempts_left = 4 * limit
+        attempts_left = 4 * max(limit, 0)
+        skipped = 0  # distinct candidates dropped by either cap
         for ins in Disassembly(code).instruction_list:
             if ins.name != "PUSH20":
                 continue
@@ -185,8 +189,9 @@ class DynLoader:
             if not addr or addr in seen or addr in (exclude or ()):
                 continue
             seen.add(addr)
-            if attempts_left <= 0:
-                break
+            if len(out) >= limit or attempts_left <= 0:
+                skipped += 1
+                continue
             attempts_left -= 1
             try:
                 callee = self.dynld(addr)
@@ -194,8 +199,11 @@ class DynLoader:
                 continue
             if callee:
                 out.append((addr, callee))
-                if len(out) >= limit:
-                    break
+        if skipped:
+            log.warning(
+                "dynld prefetch truncated: %d candidate address(es) not "
+                "probed (limit=%d); calls to them degrade to havoc",
+                skipped, limit)
         return out
 
     def read_balance(self, address: int) -> int:

@@ -160,3 +160,18 @@ def test_berlin_warm_entry_is_not_a_value_hit():
     assert e1[1] == e2[1], (
         "second load of the same unwritten slot flipped away from the "
         "first load's leaf (berlin warm entry matched as a value hit)")
+
+
+def test_alias_probe_off_compiles_out_to_syntactic_matching():
+    """SymSpec(alias_probe=False) is the trace-time opt-out: the same
+    program that CONNECTS under the probe must fall back to the sound
+    assumed-distinct behavior (fresh leaf), pinning that the compiled-out
+    branch stays trace-valid and semantically syntactic."""
+    code = assemble(
+        0xAA, 0, "CALLDATALOAD", 0, "AND", "SSTORE",
+        0, "SLOAD", 1, "SSTORE", "STOP",
+    )
+    sf = srun(code, spec=SymSpec(alias_probe=False), propagate_every=1)
+    ent = _entry(sf, 0, 1)
+    assert ent is not None
+    assert ent[1] != 0, "probe off: load must be a fresh symbolic leaf"
