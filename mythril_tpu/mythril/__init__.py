@@ -6,8 +6,19 @@ blobs / files into :class:`EVMContract`s, analysis drives
 ``SymExecWrapper`` + ``fire_lasers`` and returns a :class:`Report`.
 """
 
-from .orchestration import (EVMContract, MythrilAnalyzer, MythrilConfig,
-                            MythrilDisassembler)
-
 __all__ = ["EVMContract", "MythrilAnalyzer", "MythrilConfig",
            "MythrilDisassembler"]
+
+
+def __getattr__(name):
+    """Lazy exports (PEP 562): orchestration pulls the whole analysis
+    stack (engine, jnp tables — which initializes a JAX backend), but
+    light subcommands (``campaign-merge``: pure dict math over per-host
+    JSONs) import from this package too and must run without touching a
+    backend — on a wedged TPU runtime the eager import hung the process
+    before main() ran."""
+    if name in __all__:
+        from . import orchestration
+
+        return getattr(orchestration, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

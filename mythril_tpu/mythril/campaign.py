@@ -23,10 +23,18 @@ import json
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
+
+if TYPE_CHECKING:  # import is heavy at runtime (engine); lazy below
+    from ..symbolic import SymSpec
 
 from ..config import DEFAULT_LIMITS, LimitsConfig
-from ..symbolic import SymSpec
+
+# NOTE: no engine imports at module level — ``campaign-merge`` (pure
+# dict math over per-host JSONs) must be runnable without initializing a
+# JAX backend: importing the symbolic package builds jnp tables, which
+# on a wedged TPU runtime hangs the process before main() ever runs.
+# SymSpec loads lazily inside CorpusCampaign.__init__.
 
 #: pad contract for short batches: plain STOP (no paths beyond the seed,
 #: no issues, negligible lane cost)
@@ -112,7 +120,7 @@ class CorpusCampaign:
         batch_size: int = 32,
         lanes_per_contract: int = 32,
         limits: LimitsConfig = DEFAULT_LIMITS,
-        spec: SymSpec = SymSpec(),
+        spec: Optional["SymSpec"] = None,  # None = SymSpec() (lazy import)
         max_steps: int = 256,
         transaction_count: int = 1,
         modules: Optional[Sequence[str]] = None,
@@ -146,6 +154,10 @@ class CorpusCampaign:
         self.batch_size = batch_size
         self.lanes_per_contract = lanes_per_contract
         self.limits = limits
+        if spec is None:
+            from ..symbolic import SymSpec
+
+            spec = SymSpec()
         self.spec = spec
         self.max_steps = max_steps
         self.transaction_count = transaction_count

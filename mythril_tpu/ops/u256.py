@@ -28,7 +28,11 @@ LIMB_BITS = 32
 WORD_BITS = 256
 _U32 = jnp.uint32
 _U64 = jnp.uint64
-_MASK32 = jnp.uint64(0xFFFFFFFF)
+# np scalar, NOT jnp.uint64(...): a module-level jnp array commits to a
+# device and therefore INITIALIZES the backend at import time — which
+# hangs every light CLI command (version/function-to-hash/campaign-merge)
+# on a wedged TPU runtime. numpy scalars promote identically inside jit.
+_MASK32 = np.uint64(0xFFFFFFFF)
 
 # ---------------------------------------------------------------------------
 # Host-side conversions (numpy, not traced)

@@ -1,0 +1,56 @@
+"""Light CLI paths must not initialize a JAX backend (round-5 invariant).
+
+``campaign-merge`` / ``function-to-hash`` / ``version`` are pure host
+work; a module-level jnp array anywhere in their import chains commits
+to a device at import time, which on a wedged TPU runtime hangs the
+process before ``main()`` runs (the round-5 ``u256._MASK32`` bug —
+docs/tpu-wedge-round5.md). Locked in by asserting, in a clean
+subprocess, that the chains import with ``xla_bridge._backends`` still
+empty.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE = """
+import sys
+sys.path.insert(0, {repo!r})
+{body}
+from jax._src import xla_bridge
+assert not xla_bridge._backends, (
+    "backend initialized by a light import: %r" % (xla_bridge._backends,))
+print("CLEAN")
+"""
+
+
+def _assert_clean(body: str):
+    # a clean env (no JAX_PLATFORMS pin): the invariant is that the
+    # import itself never ASKS for a backend, whatever the platform
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    r = subprocess.run(
+        [sys.executable, "-c", _PROBE.format(repo=REPO, body=body)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert r.returncode == 0 and "CLEAN" in r.stdout, (
+        f"light import touched a backend:\n{r.stdout}\n{r.stderr[-2000:]}")
+
+
+def test_campaign_merge_chain_is_backend_free():
+    _assert_clean(
+        "from mythril_tpu.mythril.campaign import merge_campaigns\n"
+        "assert merge_campaigns([{'contracts': 1}])['contracts'] == 1")
+
+
+def test_signature_keccak_chain_is_backend_free():
+    _assert_clean(
+        "from mythril_tpu.utils.signatures import selector_of\n"
+        "assert selector_of('transfer(address,uint256)') == 'a9059cbb'")
+
+
+def test_cli_parser_and_version_are_backend_free():
+    _assert_clean(
+        "from mythril_tpu.interfaces.cli import create_parser\n"
+        "create_parser().parse_args(['version'])")
