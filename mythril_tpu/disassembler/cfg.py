@@ -39,16 +39,20 @@ class Node:
     instructions: List[EvmInstruction] = field(default_factory=list)
     reached: Optional[bool] = None  # filled from a visited bitmap
 
+    def lines(self, limit: int = 20):
+        """Formatted instruction lines (one truncation rule for every
+        rendering — DOT and HTML must not drift)."""
+        out = [f"{i.address} {i.name}"
+               + (f" 0x{i.argument.hex()}" if i.argument else "")
+               for i in self.instructions[:limit]]
+        if len(self.instructions) > limit:
+            out.append("...")
+        return out
+
     @property
     def label(self) -> str:
         head = f"{self.start}..{self.end}"
-        body = "\\l".join(
-            f"{i.address} {i.name}"
-            + (f" 0x{i.argument.hex()}" if i.argument else "")
-            for i in self.instructions[:20]
-        )
-        more = "\\l..." if len(self.instructions) > 20 else ""
-        return f"{head}\\l{body}{more}\\l"
+        return head + "\\l" + "\\l".join(self.lines()) + "\\l"
 
 
 @dataclass
@@ -141,3 +145,88 @@ class CFG:
             out.append(f'  n{e.src} -> n{e.dst} [style={styles[e.jump_type]}];')
         out.append("}")
         return "\n".join(out)
+
+    def as_html(self, name: str = "cfg") -> str:
+        """Self-contained interactive HTML view (reference:
+        ``--graph out.html`` renders the LASER graph with a bundled JS
+        layout, ``mythril/analysis/callgraph.py`` ⚠unv). Zero external
+        resources — the layout is a small inline script (layered by
+        basic-block order, SVG edges, hover highlights, reached blocks
+        tinted), so the file opens anywhere including air-gapped boxes.
+        """
+        import html as _html
+        import json as _json
+
+        nodes = [{
+            "uid": n.uid, "start": n.start, "end": n.end,
+            "reached": n.reached,
+            "text": "\n".join(n.lines()),
+        } for n in self.nodes]
+        edges = [{"src": e.src, "dst": e.dst, "kind": e.jump_type.name}
+                 for e in self.edges]
+        payload = _json.dumps({"name": name, "nodes": nodes,
+                               "edges": edges})
+        # placeholders a hostile contract NAME cannot smuggle into the
+        # other substitution: the data slot includes quotes (json escapes
+        # any quote in `name` to \"), the title slot includes <> (html
+        # escaping turns them into entities)
+        return (_HTML_TEMPLATE
+                .replace('"@DATA@"', payload)
+                .replace("<!--TITLE-->", _html.escape(name)))
+
+
+_HTML_TEMPLATE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title><!--TITLE--> — CFG</title>
+<style>
+ body{font-family:monospace;background:#1e1e1e;color:#ddd;margin:0}
+ #hdr{padding:8px 12px;background:#2d2d2d;position:sticky;top:0}
+ svg{display:block}
+ .blk rect{fill:#263238;stroke:#546e7a;rx:4}
+ .blk.reached rect{fill:#1b3a2a;stroke:#66bb6a}
+ .blk.unreached rect{fill:#2a2a2a;stroke:#555}
+ .blk:hover rect{stroke:#ffca28;stroke-width:2}
+ .blk text{fill:#ddd;font-size:11px;white-space:pre}
+ path.CONDITIONAL{stroke:#ffb74d;stroke-dasharray:6 3}
+ path.UNCONDITIONAL{stroke:#4fc3f7}
+ path.FALLTHROUGH{stroke:#9e9e9e;stroke-dasharray:2 3}
+ path{fill:none;stroke-width:1.5;opacity:.8}
+</style></head><body>
+<div id="hdr"><!--TITLE--> — control-flow graph (green = explored)</div>
+<div id="g"></div>
+<script>
+const D = "@DATA@";
+const CW = 8, LH = 13, PADX = 10, PADY = 8, GAPX = 40, GAPY = 46;
+// flow layout: blocks in pc order, wrapping rows; curved SVG edges
+let x = 20, y = 20, rowH = 0, maxW = 0;
+const pos = {};
+D.nodes.sort((a,b)=>a.start-b.start).forEach(n => {
+  const lines = n.text.split("\\n");
+  const w = PADX*2 + CW*Math.max(...lines.map(l=>l.length), 8);
+  const h = PADY*2 + LH*lines.length;
+  if (x + w > 1500) { x = 20; y += rowH + GAPY; rowH = 0; }
+  pos[n.uid] = {x, y, w, h, n, lines};
+  x += w + GAPX; rowH = Math.max(rowH, h); maxW = Math.max(maxW, x);
+});
+const H = y + rowH + 40;
+let svg = `<svg width="${Math.max(maxW,800)}" height="${H}" xmlns="http://www.w3.org/2000/svg">`;
+D.edges.forEach(e => {
+  const a = pos[e.src], b = pos[e.dst]; if (!a || !b) return;
+  const x1 = a.x + a.w/2, y1 = a.y + a.h, x2 = b.x + b.w/2, y2 = b.y;
+  const my = (y1 + y2) / 2;
+  svg += `<path class="${e.kind}" d="M${x1},${y1} C${x1},${my} ${x2},${my} ${x2},${y2}"/>`;
+});
+D.nodes.forEach(n => {
+  const p = pos[n.uid];
+  const cls = n.reached === true ? "blk reached" :
+              n.reached === false ? "blk unreached" : "blk";
+  svg += `<g class="${cls}"><rect x="${p.x}" y="${p.y}" width="${p.w}" height="${p.h}"/>`;
+  p.lines.forEach((l, i) => {
+    svg += `<text x="${p.x+PADX}" y="${p.y+PADY+LH*(i+0.8)}">${l
+      .replace(/&/g,"&amp;").replace(/</g,"&lt;")}</text>`;
+  });
+  svg += `</g>`;
+});
+svg += `</svg>`;
+document.getElementById("g").innerHTML = svg;
+</script></body></html>
+"""

@@ -98,8 +98,9 @@ def create_parser() -> argparse.ArgumentParser:
                         "engine's default; the reference flag name, kept "
                         "for parity — conflicts with --concrete-storage)")
     a.add_argument("--graph", metavar="PATH",
-                   help="write the contract CFG as graphviz DOT, explored "
-                        "blocks highlighted")
+                   help="write the contract CFG with explored blocks "
+                        "highlighted: *.html gets a self-contained "
+                        "interactive page, anything else graphviz DOT")
     a.add_argument("--statespace-json", metavar="PATH",
                    help="dump the explored statespace as JSON: per-tx "
                         "surviving paths (pc, depth, constraints) + "
@@ -498,7 +499,9 @@ def _write_statespace(path: str, analyzer) -> None:
 
 
 def _write_graph(path: str, contract, analyzer) -> None:
-    """DOT CFG of the first contract, explored blocks highlighted."""
+    """CFG of the first contract, explored blocks highlighted: a *.html
+    path gets the self-contained interactive page (reference: the
+    bundled-JS ``--graph`` HTML ⚠unv), anything else graphviz DOT."""
     from ..disassembler.cfg import CFG
 
     cfg = CFG(contract.code)
@@ -508,8 +511,13 @@ def _write_graph(path: str, contract, analyzer) -> None:
         # occupy the second half of the corpus
         ci = len(sym.images) - len(analyzer.contracts)
         cfg.mark_reached(sym._visited[ci])
-    with open(path, "w") as fh:
-        fh.write(cfg.as_dot(contract.name))
+    render = (cfg.as_html if path.lower().endswith((".html", ".htm"))
+              else cfg.as_dot)
+    # explicit utf-8: the HTML template has non-ASCII (em dashes) and a
+    # C-locale container would otherwise UnicodeEncodeError after the
+    # whole symbolic run already succeeded
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(render(contract.name))
 
 
 def exec_disassemble(args) -> int:

@@ -384,3 +384,23 @@ def test_strategy_naive_random_accepted(capsys):
     )
     assert rc == 0
     assert any(i["swc-id"] == "106" for i in json.loads(out)["issues"])
+
+
+def test_graph_html_output(tmp_path, capsys):
+    # *.html -> self-contained interactive CFG page (no external
+    # resources — verifiable offline); anything else stays DOT
+    html_p = tmp_path / "cfg.html"
+    dot_p = tmp_path / "cfg.dot"
+    for p in (html_p, dot_p):
+        rc, _ = run_cli(
+            capsys, "analyze", "-c", KILLABLE, "-t", "1",
+            "--max-steps", "32", "--lanes-per-contract", "4",
+            "--limits-profile", "test", "--graph", str(p),
+            "-m", "AccidentallyKillable", "-o", "json",
+        )
+        assert rc == 0
+    html = html_p.read_text()
+    assert html.startswith("<!DOCTYPE html>")
+    assert '"nodes":' in html and "__DATA__" not in html
+    assert "http" not in html.split("xmlns")[0]  # no external fetches
+    assert dot_p.read_text().startswith("digraph")
