@@ -11,12 +11,14 @@ model-search fallback instead of Z3 (not available in this image).
 
 from .ops import SymOp, FreeKind, WELL_KNOWN, N_WELL_KNOWN, calldata_arg_offsets
 from .state import SymFrontier, make_sym_frontier, SymSpec
-from .engine import sym_superstep, sym_run, expand_forks, append_node, between_txs
+from .engine import (sym_superstep, sym_run, expand_forks, append_node,
+                     between_txs, migrate_parked_device)
 from .propagate import propagate_feasibility, kill_infeasible
 
 __all__ = [
     "SymOp", "FreeKind", "WELL_KNOWN", "N_WELL_KNOWN", "calldata_arg_offsets",
     "SymFrontier", "make_sym_frontier", "SymSpec",
     "sym_superstep", "sym_run", "expand_forks", "append_node", "between_txs",
+    "migrate_parked_device",
     "propagate_feasibility", "kill_infeasible",
 ]

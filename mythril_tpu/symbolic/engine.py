@@ -2762,10 +2762,122 @@ def rebalance_parked(sf: SymFrontier, fork_block: int = 0):
     ), len(src_idx)
 
 
+def migrate_parked_device(sf: SymFrontier, fork_block: int,
+                          mig_cap: int = 8) -> SymFrontier:
+    """In-jit cross-block migration of starved fork-requesting lanes.
+
+    The TPU-native tier of SURVEY §5.8's "cross-device rebalancing":
+    where ``rebalance_parked`` plans on the host at the CHUNK seam (a
+    device→host→device round trip — DCN on a pod), this runs INSIDE the
+    jitted superstep loop. The only cross-block data flow is a compact
+    ``[G, MIG]`` lane-payload buffer: every reduction/cumsum runs along
+    the intra-block axis (shard-local under a block-aligned lane
+    sharding), the assignment plan is [G]-shaped metadata, and GSPMD
+    lowers the buffer exchange to a small all-gather that rides ICI.
+    The reference has no analog (single process, unbounded worklist —
+    ``mythril/laser/ethereum/svm.py`` ⚠unv); the pattern is the
+    scaling-playbook "communicate at the scheduler boundary, and only
+    compact state".
+
+    Semantics (mirrors the host planner): a lane parked on a starved
+    fork (``defer_starved`` retry machinery) whose block has ZERO free
+    slots is moved to a block with >= 2 free slots (one for the lane,
+    one headroom for the fork it re-raises next superstep); freer blocks
+    fill first; at most ``mig_cap`` lanes leave or enter any block per
+    call (bounded buffer — the rest stay parked and retry). The moved
+    lane keeps ``fork_req`` set; its old slot deactivates. iprof rows
+    travel with the lane; a replaced slot's unharvested row folds into
+    the migrant's row so harvest totals are conserved.
+    """
+    P = sf.n_lanes
+    B = fork_block if fork_block > 0 else P
+    G = P // B
+    if G <= 1:
+        return sf  # single block: nothing to migrate into
+    MIG = max(1, min(mig_cap, B // 2))
+    NF = G * MIG  # flat buffer size
+
+    ab = sf.base.active.reshape(G, B)
+    stb = (sf.fork_req & sf.base.active).reshape(G, B)
+    freeb = ~ab
+    fc = jnp.sum(freeb, axis=1, dtype=I32)            # free slots per block
+    expb = stb & (fc == 0)[:, None]                    # exportable lanes
+    r_exp = jnp.cumsum(expb.astype(I32), axis=1) - 1   # intra-block rank
+    sel = expb & (r_exp < MIG)
+    n_exp = jnp.minimum(jnp.sum(expb, axis=1, dtype=I32), MIG)
+
+    # export buffer slot j <- intra-block lane with rank j (B = empty pad)
+    hit = sel[:, :, None] & (r_exp[:, :, None] == jnp.arange(MIG)[None, None, :])
+    exp_idx = jnp.where(jnp.any(hit, axis=1),
+                        jnp.argmax(hit, axis=1), B).astype(I32)  # [G, MIG]
+
+    # import capacity: fc-1 keeps one slot of fork headroom; freer blocks
+    # get lower global import ranks so they fill first
+    cap = jnp.clip(fc - 1, 0, MIG)
+    order = jnp.argsort(-fc, stable=True)
+    cap_sorted = cap[order]
+    ioff_sorted = jnp.cumsum(cap_sorted) - cap_sorted  # exclusive prefix
+    ioff = jnp.zeros(G, I32).at[order].set(ioff_sorted.astype(I32))
+    total_cap = jnp.sum(cap, dtype=I32)
+
+    eoff = (jnp.cumsum(n_exp) - n_exp).astype(I32)     # global export ranks
+    total_exp = jnp.sum(n_exp, dtype=I32)
+    M = jnp.minimum(total_exp, total_cap)              # matched moves
+
+    # flat buffer id per global export rank (NF = unmatched sentinel)
+    grank = eoff[:, None] + jnp.arange(MIG, dtype=I32)[None, :]
+    valid_e = jnp.arange(MIG)[None, :] < n_exp[:, None]
+    flat_ids = jnp.arange(NF, dtype=I32).reshape(G, MIG)
+    src_of_rank = jnp.full(NF, NF, I32).at[
+        jnp.where(valid_e, grank, NF)].set(flat_ids, mode="drop")
+
+    # t-th free slot of block g receives global import rank ioff[g] + t
+    r_free = jnp.cumsum(freeb.astype(I32), axis=1) - 1
+    imp_take = jnp.clip(M - ioff, 0, cap)              # imports per block
+    is_imp = freeb & (r_free < imp_take[:, None])      # [G, B]
+    q = ioff[:, None] + r_free
+    srcflat = src_of_rank[jnp.clip(q, 0, NF - 1)]      # [G, B]
+    srcflat = jnp.where(is_imp, srcflat, 0)            # harden pads
+
+    exported = sel & ((eoff[:, None] + r_exp) < M)     # claimed -> vacate
+    imp_flat = is_imp.reshape(P)
+
+    def mv(x):
+        if not hasattr(x, "ndim") or x.ndim == 0:
+            return x
+        rest = x.shape[1:]
+        xb = x.reshape((G, B) + rest)
+        idx = jnp.clip(exp_idx, 0, B - 1).reshape(
+            (G, MIG) + (1,) * len(rest))
+        buf = jnp.take_along_axis(
+            xb, jnp.broadcast_to(idx, (G, MIG) + rest), axis=1)
+        flat = buf.reshape((NF,) + rest)
+        vals = flat[srcflat]                           # [G, B, ...] from NF
+        sel_imp = is_imp.reshape((G, B) + (1,) * len(rest))
+        return jnp.where(sel_imp, vals, xb).reshape(x.shape)
+
+    new = jax.tree.map(mv, sf)
+    vac = exported.reshape(P)
+    b = new.base.replace(active=new.base.active & ~vac)
+    if b.op_hist is not None:
+        # migrant rows travelled via mv(); vacated rows zero (they moved);
+        # replaced slots' pre-import rows (retired-lane counts harvest has
+        # not seen) fold into the first imported slot's row — totals are
+        # conserved because harvest sums every row
+        dead_rows = jnp.sum(
+            jnp.where(imp_flat[:, None], sf.base.op_hist, 0),
+            axis=0).astype(I32)
+        tgt = jnp.argmax(imp_flat).astype(I32)
+        b = b.replace(op_hist=jnp.where(vac[:, None], 0, b.op_hist)
+                      .at[tgt].add(jnp.where(jnp.any(imp_flat),
+                                             dead_rows, 0)))
+    return new.replace(base=b, fork_req=new.fork_req & ~vac)
+
+
 @functools.partial(
     jax.jit, static_argnames=("spec", "limits", "max_steps", "propagate_every",
                               "fork_block", "track_coverage", "fork_policy",
-                              "defer_starved")
+                              "defer_starved", "migrate_every")
 )
 def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
             spec: SymSpec = SymSpec(),
@@ -2775,7 +2887,8 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
             fork_block: int = 0,
             track_coverage: bool = False,
             fork_policy: str = "fifo",
-            defer_starved: bool = False):
+            defer_starved: bool = False,
+            migrate_every: int = 0):
     """Run the symbolic engine until quiescence or max_steps supersteps.
     ``propagate_every`` > 0 interleaves feasibility sweeps that kill
     provably-unsat lanes (reference: lazy ``Solver.check()`` pruning);
@@ -2784,12 +2897,18 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
     per-device lane count when sharding the lane axis).
     ``track_coverage=True`` additionally returns a ``bool[C, MAX_CODE]``
     visited-pc bitmap (reference: InstructionCoveragePlugin ⚠unv) —
-    return type becomes ``(sf, visited)``."""
+    return type becomes ``(sf, visited)``.
+    ``migrate_every`` > 0 (with ``defer_starved`` and a multi-block
+    ``fork_block``) runs the in-jit cross-block lane migration
+    (``migrate_parked_device``) every that many supersteps — the ICI
+    tier of SURVEY §5.8's rebalancing; the host-seam
+    ``rebalance_parked`` remains the chunk-boundary tier."""
     from .propagate import kill_infeasible
 
     if propagate_every is None:
         propagate_every = limits.propagate_every
 
+    P_run = sf.n_lanes
     C, MC = corpus.code.shape
     visited0 = jnp.zeros((C, MC), dtype=bool)
 
@@ -2825,6 +2944,25 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
                 ("iv_lo", "iv_hi", "kb_m", "kb_v", "prop_len",
                  "base.active", "fork_req", "killed_infeasible",
                  "killed_total"),
+            )
+        if migrate_every > 0 and defer_starved and 0 < fork_block < P_run:
+            # fire only when some block is BOTH exhausted and starving —
+            # the [G] predicate is metadata-cheap; the payload pass is
+            # inside the cond
+            Bm = fork_block
+            abm = s.base.active.reshape(P_run // Bm, Bm)
+            stm = (s.fork_req & s.base.active).reshape(P_run // Bm, Bm)
+            occ = jnp.sum(abm, axis=1)
+            # a starving exhausted block AND a destination with >= 2 free
+            # slots — without the capacity side a saturated frontier would
+            # pay the full-leaf no-op migration pass every firing
+            need = (jnp.any(jnp.any(stm, axis=1) & (occ == Bm))
+                    & jnp.any(occ <= Bm - 2))
+            s = lax.cond(
+                ((i % migrate_every) == migrate_every - 1) & need,
+                lambda x: migrate_parked_device(x, fork_block),
+                lambda x: x,
+                s,
             )
         return i + 1, s, visited
 
