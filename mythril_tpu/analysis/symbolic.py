@@ -246,6 +246,7 @@ class SymExecWrapper:
         strategy: str = "bfs",
         spill: bool = True,
         fork_block: int = 0,
+        migrate_every: int = 8,
         enable_iprof: bool = False,
     ):
         import time as _time
@@ -279,6 +280,12 @@ class SymExecWrapper:
         # other blocks' free slots between chunks
         self.spill = spill
         self.fork_block = fork_block
+        # in-jit cross-block migration (SURVEY §5.8 ICI tier): only
+        # meaningful when fork compaction is blocked (fork_block > 0) and
+        # spill parks starved lanes; a no-op otherwise (and inside
+        # sym_run when G == 1). The host-seam rebalance stays as the
+        # chunk-boundary tier for lanes migration could not place.
+        self.migrate_every = migrate_every if spill else 0
         self._parked_end = 0
         self._rebalanced = 0
         self._chunk = max(1, deadline_chunk_steps)
@@ -383,7 +390,8 @@ class SymExecWrapper:
                     max_steps=n,
                     track_coverage=True, fork_policy=self.fork_policy,
                     fork_block=self.fork_block,
-                    defer_starved=self.spill)
+                    defer_starved=self.spill,
+                    migrate_every=self.migrate_every)
                 self._visited |= np.asarray(vis)
                 # a shape's first run pays XLA compilation — not a sample
                 if n in warm_shapes:
@@ -425,7 +433,8 @@ class SymExecWrapper:
                         sf, env, self.corpus, spec, limits,
                         max_steps=self._chunk,
                         track_coverage=True, fork_policy=self.fork_policy,
-                        fork_block=self.fork_block, defer_starved=True)
+                        fork_block=self.fork_block, defer_starved=True,
+                        migrate_every=self.migrate_every)
                     self._visited |= np.asarray(vis)
                 # forks still parked after draining are lost coverage —
                 # count them in the drop channel for honesty

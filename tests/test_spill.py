@@ -31,7 +31,7 @@ def branchy(n_branches: int) -> bytes:
 QUIET = assemble(1, 0, "SSTORE", "STOP")
 
 
-def run_mix(spill: bool):
+def run_mix(spill: bool, migrate_every: int = 8):
     # branchy explores 2^4 = 16 paths but its block holds only 12 lanes;
     # the quiet contract's block idles with 11 free — global capacity (24)
     # fits every path, so spill must recover ALL of them
@@ -43,22 +43,36 @@ def run_mix(spill: bool):
         max_steps=64,
         transaction_count=1,
         spill=spill,
+        migrate_every=migrate_every,
     )
 
 
-def test_spill_requeues_dropped_forks():
+def test_spill_requeues_dropped_forks_host_tier():
+    """migrate_every=0 pins the HOST rebalance tier on its own."""
     base = run_mix(spill=False)
     cov0 = base.coverage
     assert cov0["dropped_forks"] > 0, \
         "fixture must saturate its block without spill"
 
-    sym = run_mix(spill=True)
+    sym = run_mix(spill=True, migrate_every=0)
     cov1 = sym.coverage
     assert cov1["dropped_forks"] == 0, f"forks still lost: {cov1}"
     assert cov1["rebalanced_lanes"] > 0, "host rebalance never fired"
     # the full 2^4 path set for the branchy contract + 1 quiet path
     assert cov1["surviving_paths"] == 17, cov1["surviving_paths"]
     assert cov1["surviving_paths"] > cov0["surviving_paths"]
+
+
+def test_spill_in_jit_migration_tier():
+    """Default driver config: the in-jit migration places starved lanes
+    before the chunk seam, so the host tier has nothing left to do and
+    the path set is still complete."""
+    sym = run_mix(spill=True)   # migrate_every=8 (driver default)
+    cov = sym.coverage
+    assert cov["dropped_forks"] == 0, f"forks still lost: {cov}"
+    assert cov["surviving_paths"] == 17, cov["surviving_paths"]
+    assert cov["rebalanced_lanes"] == 0, \
+        "in-jit migration should pre-empt the host seam on this fixture"
 
 
 def test_spill_issue_parity():
