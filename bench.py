@@ -346,6 +346,19 @@ def _cpu_fallback(diag: str) -> None:
         extra = rec.get("extra", {})
         extra["platform"] = "cpu-fallback"
         extra["tpu_error"] = diag[:300]
+        # the most recent chip measurements (tools/profile_superstep.py
+        # writes them on every headline-config TPU run), so a
+        # wedged-tunnel round still surfaces hardware evidence
+        try:
+            with open(os.path.join(here, ".tpu_profile_latest.json")) as fh:
+                hist = json.load(fh)
+            extra["last_tpu_measured"] = {
+                p: {"date": r.get("date"),
+                    "lane_steps_per_sec": r.get("lane_steps_per_sec")}
+                for p, r in sorted(hist.items(), key=lambda kv: int(kv[0]))
+            }
+        except (OSError, ValueError, AttributeError, TypeError):
+            pass  # optional decoration must never sink the record itself
         _emit(rec.get("value", 0.0), rec.get("vs_baseline", 0.0),
               "CPU-FALLBACK " + rec.get("unit", ""), extra,
               error="tpu backend unavailable: " + diag)
