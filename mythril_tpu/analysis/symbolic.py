@@ -9,6 +9,7 @@ consume batched.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -24,6 +25,8 @@ from ..smt.tape import (HostNode, HostTape, TapeHostCache, extract_tape,
                         intern_node)
 from ..symbolic import SymSpec, between_txs, make_sym_frontier, sym_run
 from ..symbolic.engine import rebalance_parked
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -248,6 +251,8 @@ class SymExecWrapper:
         fork_block: int = 0,
         migrate_every: int = 8,
         enable_iprof: bool = False,
+        dyn_loader=None,
+        dynld_limit: int = 4,
     ):
         import time as _time
 
@@ -313,6 +318,24 @@ class SymExecWrapper:
         self.corpus = Corpus.from_images(images)
         self._visited = np.zeros(
             (len(images), limits.max_code), dtype=bool)
+        # mid-execution dynamic loading (reference: DynLoader.dynld
+        # resolving CALL targets as execution reaches them ⚠unv, SURVEY
+        # §3.4): the corpus is a static jit shape, so loading happens at
+        # the BETWEEN-TX host seam — tx N's concrete-but-unknown call
+        # targets are fetched, appended to the corpus, and registered in
+        # the account table, and tx N+1's calls to them resolve into
+        # real code (load-on-first-touch, one tx later; the pre-pass in
+        # utils/loader.py prefetch_callees covers the static-reference
+        # case up front). None = offline, no attempt.
+        self.dyn_loader = dyn_loader
+        self.dynld_limit = dynld_limit
+        from ..core.frontier import contract_address
+        self._known_addrs = set(
+            contract_addrs if contract_addrs is not None
+            else [contract_address(i) for i in range(C)])
+        self._dynld_miss: set = set()
+        self._dynld_fails: Dict[int, int] = {}  # transient-failure counts
+        self.dynld_loaded: List[int] = []  # addresses loaded mid-run
         P = C * lanes_per_contract
         cid0 = np.repeat(np.arange(C, dtype=np.int32), lanes_per_contract)
         cid_runtime = cid0 + runtime_base
@@ -463,6 +486,10 @@ class SymExecWrapper:
                     op_hist=jnp.zeros_like(sf.base.op_hist)))
             self.plugin_loader.fire("on_tx_end", ctx)
             if not is_last:
+                if self.dyn_loader is not None:
+                    # must run BEFORE between_txs: it reads this tx's
+                    # call log, which the handoff clears
+                    sf = self._dynld_between_txs(sf, names)
                 kw = dict(handoff_kw or {})
                 # with a creation tx, the first MESSAGE call is tx_id 1 —
                 # the dependency pruner must not retire its paths
@@ -501,6 +528,107 @@ class SymExecWrapper:
         self.sf = sf
         self.ctx = self.tx_contexts[-1]
         self.plugin_loader.fire("on_run_end", self)
+
+    def _dynld_between_txs(self, sf, names):
+        """Fetch code for this tx's concrete-but-unknown call targets.
+
+        Reference: ``DynLoader.dynld`` loads callee code the moment LASER
+        executes a CALL to an unknown address (⚠unv, SURVEY §3.4). The
+        frontier analog defers to the tx seam: harvest the call log's
+        concrete targets, fetch the unknown ones over RPC, append their
+        images to the corpus (a new static shape — the next chunk pays
+        one recompile) and register them in a per-lane-free account-table
+        column, so the NEXT transaction's calls resolve into real code.
+        Paths of the current tx that already took the havoc leaf for such
+        a call stay sound over-approximations, same as the pre-load state
+        of the reference. Misses and successes are cached; the per-run
+        load budget is ``dynld_limit``.
+        """
+        import jax.numpy as jnp
+
+        from ..core.frontier import CREATOR_ADDRESS
+        from ..ops import u256
+        from ..symbolic.engine import CREATE_ADDR_BASE
+        from ..utils.loader import DynLoaderError
+
+        limits = self.limits
+        budget = self.dynld_limit - len(self.dynld_loaded)
+        if budget <= 0:
+            return sf
+        b = sf.base
+        n = np.asarray(sf.n_calls)
+        CL = sf.call_to.shape[1]
+        conc = ((np.arange(CL)[None, :] < n[:, None])
+                & (np.asarray(sf.call_to_sym) == 0))
+        to = np.asarray(sf.call_to)
+        cand = {int(u256.to_int(to[p, j])) for p, j in zip(*np.where(conc))}
+        skip = self._known_addrs | self._dynld_miss
+        fetched = []
+        for a in sorted(cand):
+            if (not 0 < a < 1 << 160 or a in skip
+                    or a in (ATTACKER_ADDRESS, CREATOR_ADDRESS)
+                    or CREATE_ADDR_BASE <= a < CREATE_ADDR_BASE + (1 << 32)):
+                continue  # pseudo-addresses of CREATE results are local
+            if len(fetched) >= budget:
+                log.warning("dynld: per-run budget %d reached; remaining "
+                            "unknown callees stay havoc", self.dynld_limit)
+                break
+            try:
+                code = self.dyn_loader.dynld(a)
+            except DynLoaderError as e:
+                # a transport/format failure is NOT "no code": retry at
+                # the next seam, and only cache the miss after repeated
+                # failures (a transient 5xx must not havoc a live callee
+                # for the rest of a long multi-tx run)
+                fails = self._dynld_fails.get(a, 0) + 1
+                self._dynld_fails[a] = fails
+                if fails >= 2:
+                    self._dynld_miss.add(a)
+                log.warning("dynld 0x%040x failed (attempt %d): %s",
+                            a, fails, e)
+                continue
+            if not code or len(code) > limits.max_code:
+                self._dynld_miss.add(a)  # EOA / oversized: stays havoc
+                continue
+            fetched.append((a, code))
+        if not fetched:
+            return sf
+        used = np.asarray(b.acct_used)
+        free_cols = np.where(~used.any(axis=0))[0]
+        if len(free_cols) < len(fetched):
+            log.warning(
+                "dynld: account table holds %d of %d loaded callees "
+                "(max_accounts=%d); the rest stay havoc",
+                len(free_cols), len(fetched), used.shape[1])
+            for a, _ in fetched[len(free_cols):]:
+                self._dynld_miss.add(a)  # retrying can never succeed
+            fetched = fetched[:len(free_cols)]
+            if not fetched:
+                return sf
+        addr_np = np.asarray(b.acct_addr).copy()
+        code_np = np.asarray(b.acct_code).copy()
+        used_np = used.copy()
+        for col, (a, code) in zip(free_cols, fetched):
+            idx = len(self.images)
+            self.images.append(
+                ContractImage.from_bytecode(code, limits.max_code))
+            names.append(f"onchain_0x{a:040x}")
+            self._known_addrs.add(a)
+            self.dynld_loaded.append(a)
+            addr_np[:, col] = u256.from_int(a)
+            code_np[:, col] = idx
+            used_np[:, col] = True
+            log.info("dynld: loaded 0x%040x (%d bytes) as corpus #%d",
+                     a, len(code), idx)
+        self.corpus = Corpus.from_images(self.images)
+        grow = len(self.images) - self._visited.shape[0]
+        self._visited = np.vstack(
+            [self._visited, np.zeros((grow, limits.max_code), dtype=bool)])
+        return sf.replace(base=b.replace(
+            acct_addr=jnp.asarray(addr_np),
+            acct_code=jnp.asarray(code_np),
+            acct_used=jnp.asarray(used_np),
+        ))
 
     def _save_checkpoint(self, sf, steps_done: int) -> None:
         import os

@@ -227,6 +227,8 @@ def _load_contracts(args):
         from ..utils.loader import DynLoader, rpc_client_from_uri
 
         dl = DynLoader(rpc_client_from_uri(args.rpc))
+        args._dynld = dl  # exec_analyze reuses this client for mid-run
+        # loading instead of opening a second connection to the node
         target_addr = int(args.address, 16)
         code = dl.dynld(target_addr)
         if not code:
@@ -344,6 +346,18 @@ def exec_analyze(args) -> int:
         enable_iprof=args.enable_iprof,
         plugins=tuple(_discover_plugins(args.plugin_dir)),
     )
+    if getattr(args, "rpc", None) and not getattr(
+            args, "no_onchain_callees", False):
+        # mid-execution dynamic loading (reference DynLoader.dynld ⚠unv):
+        # runtime-computed call targets the PUSH20 pre-pass cannot see
+        # are fetched at tx seams and resolve in the following tx;
+        # reuse the -a path's client when one exists
+        dl = getattr(args, "_dynld", None)
+        if dl is None:
+            from ..utils.loader import DynLoader, rpc_client_from_uri
+
+            dl = DynLoader(rpc_client_from_uri(args.rpc))
+        cfg = dataclasses.replace(cfg, dyn_loader=dl)
     analyzer = MythrilAnalyzer(contracts, cfg)
     modules = args.modules.split(",") if args.modules else None
     report = analyzer.fire_lasers(modules=modules)

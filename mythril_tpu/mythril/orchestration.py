@@ -46,6 +46,11 @@ class MythrilConfig:
     enable_iprof: bool = False            # per-opcode instruction profiler
     plugins: tuple = ()                   # LaserPlugin instances (e.g. from
     # outer discovery, plugin/discovery.py)
+    dyn_loader: object = None             # utils.loader.DynLoader: enables
+    # MID-EXECUTION dynamic loading — tx N's concrete-but-unknown call
+    # targets are fetched at the tx seam and resolve in tx N+1
+    # (reference: DynLoader.dynld on CALL ⚠unv, SURVEY §3.4)
+    dynld_limit: int = 4                  # per-run mid-execution loads
 
     def resolved_limits(self) -> LimitsConfig:
         if self.loop_bound is None:
@@ -164,6 +169,8 @@ class MythrilAnalyzer:
             strategy=cfg.strategy,
             enable_iprof=cfg.enable_iprof,
             plugins=cfg.plugins,
+            dyn_loader=cfg.dyn_loader,
+            dynld_limit=cfg.dynld_limit,
         )
         report = fire_lasers(self.sym, white_list=modules,
                              parallel=cfg.parallel_solving)

@@ -6,9 +6,16 @@ Three client tiers behind one Protocol: :class:`HttpRpcClient` (a real
 this image has zero egress), :class:`FileRpcClient` (the JSON-file mock
 the reference's RPC tests use, SURVEY.md §4), and anything duck-typed
 with ``eth_getCode`` / ``eth_getStorageAt``. Loaded code/storage feed
-the analysis as ordinary bytecode / concrete storage seeds; there is no
-mid-execution dynamic loading (the corpus is device-resident and static
-per run — a deliberate frontier-first divergence, documented here).
+the analysis two ways (reference ``DynLoader.dynld`` resolves CALL
+targets the moment LASER reaches them; the frontier's corpus is a
+static jit shape, so loading happens at host seams instead):
+
+- **pre-pass**: :meth:`DynLoader.prefetch_callees` scans the target's
+  PUSH20 immediates up front and loads statically-referenced callees;
+- **between-tx**: ``SymExecWrapper._dynld_between_txs`` harvests tx N's
+  concrete-but-unknown call targets (runtime-computed addresses the
+  pre-pass cannot see), fetches them, and registers them so tx N+1's
+  calls resolve into real code — load-on-first-touch, one tx later.
 """
 
 from __future__ import annotations
@@ -107,8 +114,12 @@ class HttpRpcClient:
                     time.sleep(0.1 * (attempt + 1))
         else:
             raise DynLoaderError(f"rpc transport failed: {last}") from last
+        if not isinstance(body, dict):
+            raise DynLoaderError(f"malformed rpc response: {body!r}")
         if "error" in body:
             raise DynLoaderError(f"rpc error: {body['error']}")
+        if "result" not in body:
+            raise DynLoaderError(f"rpc response missing result: {body!r}")
         return body["result"]
 
     def eth_getCode(self, address: str) -> str:
@@ -150,14 +161,25 @@ class DynLoader:
         return self.client
 
     def dynld(self, address: int) -> bytes:
-        """Runtime bytecode of a live contract."""
+        """Runtime bytecode of a live contract. Every malformed-response
+        shape (null / non-string / odd or garbage hex) surfaces as
+        :class:`DynLoaderError` — callers treat any failure as "no code,
+        degrade to havoc" and must never crash an in-flight analysis."""
         code = self._require().eth_getCode(f"0x{address:040x}")
-        return bytes.fromhex(code.removeprefix("0x"))
+        try:
+            return bytes.fromhex(code.removeprefix("0x"))
+        except (AttributeError, TypeError, ValueError) as e:
+            raise DynLoaderError(
+                f"malformed eth_getCode result {code!r}: {e}") from e
 
     def read_storage(self, address: int, slot: int) -> int:
         word = self._require().eth_getStorageAt(
             f"0x{address:040x}", f"0x{slot:x}")
-        return int(word, 16)
+        try:
+            return int(word, 16)
+        except (TypeError, ValueError) as e:
+            raise DynLoaderError(
+                f"malformed eth_getStorageAt result {word!r}: {e}") from e
 
     def prefetch_callees(self, code: bytes, limit: int = 4, exclude=()):
         """Dynamic loading of statically-referenced callees (reference:

@@ -202,6 +202,7 @@ def main():
     # PROF_STACK/PROF_MEM debug run must not clobber a real number).
     headline = (res["backend"] == "tpu" and ac is not None
                 and MAX_STEPS == 256 and REPS == 20
+                and prof.get("all_cond_ok_lanes", 0) > 0  # run really ran
                 and not (os.environ.get("PROF_STACK")
                          or os.environ.get("PROF_MEM")))
     if headline:
@@ -213,8 +214,14 @@ def main():
                 hist = json.load(fh)
         except (OSError, ValueError):
             hist = {}
+        # every stored field derives from the all_cond run itself — a
+        # multi-variant sweep must not mix another variant's wall clock
+        # into the persisted headline record
         rec = dict(res)
+        rec["supersteps"] = prof["all_cond_steps_max"]
         rec["lane_steps_per_sec"] = round(ac[0] / ac[1], 1)
+        rec["est_min_GBps"] = round(
+            2 * res["frontier_bytes"] * rec["supersteps"] / ac[1] / 1e9, 2)
         rec["date"] = datetime.date.today().isoformat()
         hist[str(P)] = rec
         # pid-suffixed temp + atomic replace: a mid-write kill cannot
