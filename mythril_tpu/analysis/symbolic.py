@@ -9,6 +9,7 @@ consume batched.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -336,6 +337,7 @@ class SymExecWrapper:
         self._dynld_miss: set = set()
         self._dynld_fails: Dict[int, int] = {}  # transient-failure counts
         self.dynld_loaded: List[int] = []  # addresses loaded mid-run
+        self._dynld_sha: List[str] = []    # sha256 of each loaded image
         P = C * lanes_per_contract
         cid0 = np.repeat(np.arange(C, dtype=np.int32), lanes_per_contract)
         cid_runtime = cid0 + runtime_base
@@ -615,6 +617,7 @@ class SymExecWrapper:
             names.append(f"onchain_0x{a:040x}")
             self._known_addrs.add(a)
             self.dynld_loaded.append(a)
+            self._dynld_sha.append(hashlib.sha256(code).hexdigest())
             addr_np[:, col] = u256.from_int(a)
             code_np[:, col] = idx
             used_np[:, col] = True
@@ -638,7 +641,15 @@ class SymExecWrapper:
         os.makedirs(self.checkpoint_dir, exist_ok=True)
         save_frontier(
             os.path.join(self.checkpoint_dir, "frontier.npz"), sf,
-            {"tx": self._cur_tx, "steps_done": steps_done},
+            # dynld_loaded: a restorer's template corpus must append
+            # these addresses' code IN ORDER, or the frontier's
+            # acct_code indices past the original images dangle; the
+            # sha256 lets the restore verify the node still serves the
+            # bytes the checkpointed paths actually executed
+            {"tx": self._cur_tx, "steps_done": steps_done,
+             "dynld_loaded": [
+                 {"address": f"0x{a:040x}", "sha256": h}
+                 for a, h in zip(self.dynld_loaded, self._dynld_sha)]},
         )
 
     def instruction_coverage(self) -> Dict[str, float]:

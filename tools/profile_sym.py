@@ -8,6 +8,9 @@ Variants (PROF_SYM_VARIANTS, comma list; one big XLA compile each):
   - sym_nofork: SymSpec with nothing symbolic (calldata/value/storage
                 concrete) — no forks, no tape growth: the floor of the
                 sym overlay on top of the concrete interpreter
+  - sym_noalias: SymSpec(alias_probe=False) — the storage-alias probe
+                compiled OUT; the delta against `sym` is the probe's
+                cost (opt-in: add it to PROF_SYM_VARIANTS for the A/B)
 
 Prints ONE JSON object. PROF_SYM_P / PROF_SYM_STEPS / PROF_REPS size it.
 Run one variant per process when compiles are slow (axon tunnel).
@@ -69,6 +72,10 @@ def main():
         "sym_noprop": (SymSpec(), 0),
         "sym_nofork": (SymSpec(calldata=False, callvalue=False,
                                storage=False, block_env=False), None),
+        # alias-probe A/B (VERDICT r4 ask #6 follow-up): the round-5
+        # numeric storage-alias probe is a trace-time gate — "sym" above
+        # IS the alias_probe=True arm; this is the compiled-out arm
+        "sym_noalias": (SymSpec(alias_probe=False), None),
     }
     prof = {}
     for name in sel:
