@@ -284,45 +284,16 @@ def _arm_watchdog(budget: float):
 def _probe_backend(timeout_s: float = 75.0, retries: int = 2):
     """Initialize the JAX backend in a SUBPROCESS with a timeout, so a hung
     TPU runtime (round 3: driver bench + judge re-run both hung >590 s in
-    backend init) cannot take this process down with it. A child wedged in
-    an uninterruptible driver call survives SIGKILL — it is ABANDONED, not
-    waited on (subprocess.run would block forever in wait()). Returns
+    backend init) cannot take this process down with it. Now delegated to
+    the shared BackendManager (mythril_tpu/resilience.py) — the same
+    probe/abandon machinery the campaign and the profiler use. The import
+    is lazy and backend-free (resilience touches no jnp tables). Returns
     (ok, diagnosis)."""
-    import subprocess
-    import tempfile
+    from mythril_tpu.resilience import BackendManager
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    diag = ""
-    for attempt in range(retries):
-        with tempfile.TemporaryFile(mode="w+") as out:
-            p = subprocess.Popen(
-                [sys.executable, "-c",
-                 "import sys; sys.path.insert(0, %r); " % here
-                 + "import mythril_tpu, jax; d = jax.devices(); "
-                   "print('OK', jax.default_backend(), len(d))"],
-                stdout=out, stderr=subprocess.STDOUT,
-            )
-            deadline = time.monotonic() + timeout_s
-            while time.monotonic() < deadline:
-                if p.poll() is not None:
-                    break
-                time.sleep(0.5)
-            if p.poll() is None:
-                p.kill()
-                try:
-                    p.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    pass  # unkillable (D-state): abandon it
-                diag = "backend init hung >%ds (attempt %d/%d)" % (
-                    timeout_s, attempt + 1, retries)
-                continue
-            out.seek(0)
-            text = out.read()
-            if p.returncode == 0 and "OK" in text:
-                return True, text.strip().splitlines()[-1]
-            diag = "backend init failed (rc=%s): %s" % (
-                p.returncode, text.strip()[-300:])
-    return False, diag
+    bm = BackendManager(init_timeout=timeout_s, max_attempts=retries,
+                        backoff=0.0)
+    return bm.probe()
 
 
 def _cpu_fallback(diag: str) -> None:

@@ -560,14 +560,23 @@ class SymExecWrapper:
         b = sf.base
         n = np.asarray(sf.n_calls)
         CL = sf.call_to.shape[1]
+        # ADVICE r5: harvest only from non-error lanes — a trapped path's
+        # call log can hold garbage targets computed past the failure
+        # point, and on a live network junk-that-happens-to-hold-code
+        # would burn dynld budget and account-table columns
+        ok_lane = ~np.asarray(b.error)
         conc = ((np.arange(CL)[None, :] < n[:, None])
-                & (np.asarray(sf.call_to_sym) == 0))
+                & (np.asarray(sf.call_to_sym) == 0)
+                & ok_lane[:, None])
         to = np.asarray(sf.call_to)
         cand = {int(u256.to_int(to[p, j])) for p, j in zip(*np.where(conc))}
         skip = self._known_addrs | self._dynld_miss
         fetched = []
         for a in sorted(cand):
-            if (not 0 < a < 1 << 160 or a in skip
+            # 0x1..0x9 are precompiles (ADVICE r5): they execute natively,
+            # never hold fetchable code — spending RPC round-trips and
+            # budget slots on them starves real callees
+            if (not 0x09 < a < 1 << 160 or a in skip
                     or a in (ATTACKER_ADDRESS, CREATOR_ADDRESS)
                     or CREATE_ADDR_BASE <= a < CREATE_ADDR_BASE + (1 << 32)):
                 continue  # pseudo-addresses of CREATE results are local
@@ -624,6 +633,12 @@ class SymExecWrapper:
             log.info("dynld: loaded 0x%040x (%d bytes) as corpus #%d",
                      a, len(code), idx)
         self.corpus = Corpus.from_images(self.images)
+        # ADVICE r5: the grown corpus is a NEW static shape — every chunk
+        # size recompiles, so the warm-shape set must reset or the next
+        # tx's first (compile-dominated) sample feeds sec_per_step and
+        # permanently inflates the deadline pacing. sec_per_step itself
+        # is per-explore()-local, so clearing the gate set suffices.
+        self._warm_chunk_shapes = set()
         grow = len(self.images) - self._visited.shape[0]
         self._visited = np.vstack(
             [self._visited, np.zeros((grow, limits.max_code), dtype=bool)])

@@ -48,6 +48,27 @@ class LimitsConfig:
 
 DEFAULT_LIMITS = LimitsConfig()
 
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """Campaign-supervisor knobs (see ``mythril_tpu/resilience.py``).
+
+    ``batch_timeout=None`` disables the per-batch watchdog (an
+    interactive single-contract analyze has ``--execution-timeout`` for
+    pacing; the watchdog exists for unattended corpus campaigns).
+    ``init_timeout`` bounds the subprocess backend probe — 75 s
+    comfortably covers a healthy TPU init (~20 s measured) while a
+    wedged runtime hangs forever (docs/tpu-wedge-round5.md)."""
+
+    batch_timeout: float | None = None  # seconds per campaign batch
+    init_timeout: float = 75.0          # seconds per backend-init probe
+    max_batch_retries: int = 1          # re-attempts before bisection
+    probe_attempts: int = 2             # backend re-init attempts
+    probe_backoff: float = 5.0          # seconds between probe attempts
+
+
+DEFAULT_RESILIENCE = ResilienceConfig()
+
 # Small limits for fast unit tests
 TEST_LIMITS = LimitsConfig(
     max_stack=32,

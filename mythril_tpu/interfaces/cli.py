@@ -122,6 +122,29 @@ def create_parser() -> argparse.ArgumentParser:
                    help="contracts per compiled batch (campaign mode)")
     a.add_argument("--checkpoint-dir", metavar="DIR",
                    help="campaign checkpoint directory (resume-able)")
+    a.add_argument("--batch-timeout", type=float, default=None,
+                   metavar="SEC",
+                   help="campaign mode: hard wall-clock watchdog per "
+                        "batch — a hung compile or wedged device call "
+                        "becomes a batch failure (retried, then bisected "
+                        "to quarantine the poison contract) instead of "
+                        "an indefinite stall")
+    a.add_argument("--init-timeout", type=float, default=None,
+                   metavar="SEC",
+                   help="campaign mode: probe backend init in a "
+                        "subprocess with this deadline BEFORE loading "
+                        "the engine; on failure fall back to the CPU "
+                        "backend and record the event in the report")
+    a.add_argument("--max-batch-retries", type=int, default=1,
+                   metavar="N",
+                   help="campaign mode: whole-batch re-attempts after a "
+                        "failure before bisecting it (default 1)")
+    a.add_argument("--fault-inject", metavar="SPEC",
+                   help="campaign mode (testing): inject deterministic "
+                        "faults, e.g. 'raise:contract=c002', "
+                        "'hang:batch=1', 'raise:batch=0:times=1', "
+                        "'kill:batch=2'; ';'-separated specs; the "
+                        "MYTHRIL_FAULT_INJECT env var is equivalent")
     a.add_argument("--num-hosts", type=int, default=0, metavar="N",
                    help="campaign mode: shard the corpus across N hosts; "
                         "this process analyzes slice --host-index "
@@ -308,17 +331,20 @@ def _discover_plugins(plugin_dir):
 
 
 def exec_analyze(args) -> int:
-    import dataclasses
-
-    from ..mythril import MythrilAnalyzer, MythrilConfig
-    from ..symbolic import SymSpec
-
     if args.concrete_storage and args.unconstrained_storage:
         print("error: --concrete-storage conflicts with "
               "--unconstrained-storage", file=sys.stderr)
         raise SystemExit(2)
+    # campaign mode dispatches BEFORE any engine import: --init-timeout
+    # must be able to probe (and fall back from) a wedged backend while
+    # this process is still backend-free
     if getattr(args, "corpus", None):
         return _exec_campaign(args)
+
+    import dataclasses
+
+    from ..mythril import MythrilAnalyzer, MythrilConfig
+    from ..symbolic import SymSpec
     contracts = _load_contracts(args)
     if args.code and args.creation_code:
         with open(args.creation_code) as fh:
@@ -415,8 +441,27 @@ def exec_campaign_merge(args) -> int:
 
 
 def _exec_campaign(args) -> int:
-    """Corpus campaign: BASELINE configs 2-3 (SURVEY §6)."""
+    """Corpus campaign: BASELINE configs 2-3 (SURVEY §6), supervised by
+    the resilience layer (watchdog + quarantine + backend fallback)."""
     import json
+
+    from ..config import DEFAULT_RESILIENCE
+    from ..resilience import BackendManager, FaultInjector
+
+    # backend probe FIRST, while this process is still backend-free: a
+    # wedged TPU runtime hangs jax.devices() forever (docs/
+    # tpu-wedge-round5.md); the probe wedges a subprocess instead, and
+    # the campaign degrades to the CPU backend with the event on record
+    backend = None
+    if args.init_timeout is not None:
+        backend = BackendManager(
+            init_timeout=args.init_timeout,
+            max_attempts=DEFAULT_RESILIENCE.probe_attempts,
+            backoff=DEFAULT_RESILIENCE.probe_backoff)
+        ok, diag = backend.ensure_or_fallback()
+        if not ok:
+            print(f"warning: backend unavailable ({diag}); continuing "
+                  "on the CPU backend", file=sys.stderr)
 
     from ..mythril.campaign import CorpusCampaign, load_corpus_dir
     from ..symbolic import SymSpec
@@ -448,6 +493,10 @@ def _exec_campaign(args) -> int:
         enable_iprof=args.enable_iprof,
         num_hosts=num_hosts,
         host_index=host_index,
+        batch_timeout=args.batch_timeout,
+        max_batch_retries=args.max_batch_retries,
+        fault_injector=FaultInjector.from_string(args.fault_inject),
+        backend=backend,
     )
 
     def progress(done, total, dt, n_issues):

@@ -12,7 +12,12 @@ streams fixed-shape BATCHES of contracts through ONE compiled program:
   resume skips completed batches — a killed 10k-contract run loses at
   most one batch of work;
 - the campaign report carries the BASELINE metrics: contracts/sec,
-  paths/sec, issues, solver statistics, per-batch wall times.
+  paths/sec, issues, solver statistics, per-batch wall times;
+- execution is fault-isolated (docs/resilience.md): each batch runs
+  under an optional wall-clock watchdog, a failed batch is retried then
+  BISECTED so poison contracts are quarantined individually, and
+  backend loss degrades through bounded re-probes to an explicit CPU
+  fallback — a 10k campaign loses at most the poison contracts.
 
 CLI: ``python -m mythril_tpu analyze --corpus DIR`` (see interfaces/cli).
 """
@@ -20,6 +25,7 @@ CLI: ``python -m mythril_tpu analyze --corpus DIR`` (see interfaces/cli).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from dataclasses import dataclass, field
@@ -28,13 +34,18 @@ from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
 if TYPE_CHECKING:  # import is heavy at runtime (engine); lazy below
     from ..symbolic import SymSpec
 
-from ..config import DEFAULT_LIMITS, LimitsConfig
+from ..config import DEFAULT_LIMITS, DEFAULT_RESILIENCE, LimitsConfig
+from ..resilience import (BackendManager, BatchTimeout, DeviceLostError,
+                          FaultInjector, run_with_watchdog)
+from ..utils import atomic_write_json
 
 # NOTE: no engine imports at module level — ``campaign-merge`` (pure
 # dict math over per-host JSONs) must be runnable without initializing a
 # JAX backend: importing the symbolic package builds jnp tables, which
 # on a wedged TPU runtime hangs the process before main() ever runs.
 # SymSpec loads lazily inside CorpusCampaign.__init__.
+
+log = logging.getLogger(__name__)
 
 #: pad contract for short batches: plain STOP (no paths beyond the seed,
 #: no issues, negligible lane cost)
@@ -73,6 +84,13 @@ class CampaignResult:
     solver: Dict = field(default_factory=dict)
     batch_wall: List[float] = field(default_factory=list)
     iprof: Dict[str, int] = field(default_factory=dict)  # opcode -> count
+    # fault isolation (resilience layer): poison contracts the campaign
+    # lost, batch-level retry count, per-batch outcome markers, and the
+    # BackendManager's probe/fallback/recovery event log
+    quarantined: List[Dict] = field(default_factory=list)
+    retries: int = 0
+    batch_status: List[str] = field(default_factory=list)
+    backend_events: List[Dict] = field(default_factory=list)
 
     def as_dict(self) -> Dict:
         # rates derive from the per-batch wall times, which the
@@ -106,6 +124,10 @@ class CampaignResult:
                       / self.solver["attempts"], 4)
                 if self.solver.get("attempts") else 0.0
             ),
+            "quarantined": self.quarantined,
+            "retries": self.retries,
+            "batch_status": self.batch_status,
+            "backend_events": self.backend_events,
             **({"iprof": self.iprof} if self.iprof else {}),
         }
 
@@ -133,6 +155,11 @@ class CorpusCampaign:
         solver_timeout: Optional[float] = None,
         solver_iters: int = 400,
         parallel_solving: bool = False,
+        batch_timeout: Optional[float] = DEFAULT_RESILIENCE.batch_timeout,
+        max_batch_retries: int = DEFAULT_RESILIENCE.max_batch_retries,
+        fault_injector: Optional[FaultInjector] = None,
+        backend: Optional[BackendManager] = None,
+        batch_runner=None,
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -169,6 +196,18 @@ class CorpusCampaign:
         self.solver_timeout = solver_timeout
         self.solver_iters = solver_iters
         self.parallel_solving = parallel_solving
+        # resilience layer (see mythril_tpu/resilience.py): a hard
+        # per-batch wall-clock watchdog, bounded retry, and poison
+        # bisection keep one bad contract (or one wedged compile) from
+        # taking down a 10k-contract run. ``batch_runner`` swaps the
+        # engine pass for a stub in fault-machinery tests.
+        self.batch_timeout = batch_timeout
+        self.max_batch_retries = max(0, int(max_batch_retries))
+        self.fault_injector = (fault_injector
+                               if fault_injector is not None
+                               else FaultInjector.from_env())
+        self.backend = backend
+        self._batch_runner = batch_runner
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -195,10 +234,17 @@ class CorpusCampaign:
                     f" shard_contracts)={shard}, current run is {want}; "
                     "delete the checkpoint or relaunch with the original "
                     "sharding")
+            # resilience fields arrived after the first checkpoint
+            # schema; an old (or hand-rewound) file resumes cleanly
+            for k, v in (("quarantined", []), ("retries", 0),
+                         ("batch_status", []), ("backend_events", [])):
+                state.setdefault(k, v)
             return state
         return {"next_batch": 0, "issues": [], "batch_wall": [],
                 "paths_total": 0, "dropped_forks": 0, "iprof": {},
                 "solver": {},
+                "quarantined": [], "retries": 0, "batch_status": [],
+                "backend_events": [],
                 "shard": [self.num_hosts, self.host_index,
                           len(self.contracts)]}
 
@@ -207,14 +253,143 @@ class CorpusCampaign:
         if p is None:
             return
         os.makedirs(self.checkpoint_dir, exist_ok=True)
-        tmp = p + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(state, fh)
-        os.replace(tmp, p)  # atomic: a crash never corrupts the cursor
+        atomic_write_json(p, state)  # a crash never corrupts the cursor
+
+    # --- one engine pass -----------------------------------------------
+    def _exec_batch(self, bi: int, names: List[str],
+                    codes: List[bytes]) -> Dict:
+        """Analyze one (padded) batch; returns the batch's partial
+        results. This is the unit of work the watchdog guards and the
+        bisection replays on sub-batches — always padded to
+        ``batch_size`` so every attempt replays the ONE compiled
+        engine."""
+        from ..analysis import SymExecWrapper, fire_lasers
+
+        names = list(names)
+        codes = list(codes)
+        # constant compiled shape: pad short batches with STOP stubs
+        while len(codes) < self.batch_size:
+            names.append(f"_pad_{len(codes)}")
+            codes.append(_PAD_BYTECODE)
+        sym = SymExecWrapper(
+            codes, contract_names=names, limits=self.limits,
+            spec=self.spec, lanes_per_contract=self.lanes_per_contract,
+            max_steps=self.max_steps,
+            solver_iters=self.solver_iters,
+            solver_timeout=self.solver_timeout,
+            transaction_count=self.transaction_count,
+            plugins=self.plugins,
+            enable_iprof=self.enable_iprof,
+        )
+        report = fire_lasers(sym, white_list=self.modules,
+                             parallel=self.parallel_solving)
+        cov = sym.coverage
+        issues = []
+        for issue in report.issues:
+            if issue.contract.startswith("_pad_"):
+                continue
+            d = issue.as_dict()
+            d["batch"] = bi
+            issues.append(d)
+        return {
+            "issues": issues,
+            "paths": int(cov.get("surviving_paths", 0)),
+            "dropped": int(cov.get("dropped_forks", 0)),
+            "iprof": dict(sym.iprof) if self.enable_iprof else {},
+        }
+
+    # --- fault isolation ----------------------------------------------
+    def _guarded_batch(self, bi: int, items: Sequence[tuple]) -> Dict:
+        """One attempt: fault-injection check + engine pass, under the
+        wall-clock watchdog. A hung compile / wedged device call
+        surfaces as BatchTimeout here instead of stalling the run."""
+        names = [n for n, _ in items]
+        codes = [c for _, c in items]
+
+        def work():
+            if self.fault_injector is not None:
+                self.fault_injector.fire(batch=bi, contracts=names)
+            runner = self._batch_runner or self._exec_batch
+            return runner(bi, names, codes)
+
+        return run_with_watchdog(work, self.batch_timeout,
+                                 label=f"batch {bi}")
+
+    @staticmethod
+    def _fault_reason(e: BaseException) -> str:
+        if isinstance(e, BatchTimeout):
+            return f"timeout: {e}"
+        if isinstance(e, DeviceLostError):
+            return f"device-lost: {e}"
+        return f"{type(e).__name__}: {str(e)[:200]}"
+
+    def _note_failure(self, e: BaseException) -> None:
+        # a device loss gets a bounded backend re-probe (with backoff)
+        # before the batch retries; the events land in the report
+        if isinstance(e, DeviceLostError) and self.backend is not None:
+            self.backend.recover(reason=str(e)[:200])
+
+    def _run_batch_resilient(self, bi: int,
+                             items: Sequence[tuple]) -> Dict:
+        """Full batch → retry once → bisect to the poison contract(s).
+
+        A 10k campaign must lose at most the poison contracts, never the
+        run: any batch failure (timeout, crash, device error) is retried
+        ``max_batch_retries`` times, then the batch is bisected — each
+        half replays through the same compiled shape — until the
+        offending contract(s) are isolated and quarantined with a
+        reason. InjectedKill (and real signals) still blow through
+        uncheckpointed, which is what the resume path is for."""
+        out = {"issues": [], "paths": 0, "dropped": 0, "iprof": {},
+               "quarantined": [], "retries": 0, "status": "ok"}
+
+        def merge(r: Dict) -> None:
+            out["issues"].extend(r["issues"])
+            out["paths"] += r["paths"]
+            out["dropped"] += r["dropped"]
+            for k, v in r["iprof"].items():
+                out["iprof"][k] = out["iprof"].get(k, 0) + v
+
+        try:
+            merge(self._guarded_batch(bi, items))
+            return out
+        except Exception as e:  # noqa: BLE001 — isolate, don't die
+            err = e
+            log.warning("batch %d failed (%s)", bi, self._fault_reason(e))
+        self._note_failure(err)
+        for _ in range(self.max_batch_retries):
+            out["retries"] += 1
+            try:
+                merge(self._guarded_batch(bi, items))
+                out["status"] = "ok-retry"
+                return out
+            except Exception as e:  # noqa: BLE001
+                err = e
+                self._note_failure(e)
+        # bisect: a failing group splits in half; a failing singleton is
+        # the poison — quarantine it and keep going
+        groups = [list(items)]
+        while groups:
+            g = groups.pop()
+            try:
+                merge(self._guarded_batch(bi, g))
+            except Exception as e:  # noqa: BLE001
+                self._note_failure(e)
+                if len(g) == 1:
+                    out["quarantined"].append({
+                        "name": g[0][0],
+                        "reason": self._fault_reason(e),
+                        "batch": bi,
+                    })
+                else:
+                    mid = len(g) // 2
+                    groups.append(g[mid:])
+                    groups.append(g[:mid])
+        out["status"] = f"quarantined:{len(out['quarantined'])}"
+        return out
 
     # --- the campaign --------------------------------------------------
     def run(self, progress=None) -> CampaignResult:
-        from ..analysis import SymExecWrapper, fire_lasers
         from ..smt.solver import SOLVER_STATS
 
         t_start = time.monotonic()
@@ -229,6 +404,13 @@ class CorpusCampaign:
         res.paths_total = int(state["paths_total"])
         res.dropped_forks = int(state["dropped_forks"])
         res.iprof = dict(state.get("iprof", {}))
+        res.quarantined = list(state.get("quarantined", []))
+        res.retries = int(state.get("retries", 0))
+        res.batch_status = list(state.get("batch_status", []))
+        # backend events accumulate like solver stats: prior sessions'
+        # events come from the checkpoint, this session's from the live
+        # BackendManager (snapshotted fresh at every save)
+        events_prior = list(state.get("backend_events", []))
         # solver stats accumulate ACROSS sessions: the checkpoint carries
         # the totals from prior (killed/resumed) sessions, this session's
         # delta is added per batch — so the final report's sat/unsat/
@@ -237,50 +419,37 @@ class CorpusCampaign:
         solver_prior = dict(state.get("solver", {}))
         stats_at_start = SOLVER_STATS.snapshot()
 
+        def session_events() -> List[Dict]:
+            return events_prior + (list(self.backend.events)
+                                   if self.backend is not None else [])
+
         n_batches = (len(self.contracts) + self.batch_size - 1) // self.batch_size
         for bi in range(state["next_batch"], n_batches):
             if deadline is not None and time.monotonic() >= deadline:
                 break
             batch = self.contracts[bi * self.batch_size:(bi + 1) * self.batch_size]
-            names = [n for n, _ in batch]
-            codes = [c for _, c in batch]
-            # constant compiled shape: pad the tail batch with STOP stubs
-            while len(codes) < self.batch_size:
-                names.append(f"_pad_{len(codes)}")
-                codes.append(_PAD_BYTECODE)
             t0 = time.monotonic()
-            sym = SymExecWrapper(
-                codes, contract_names=names, limits=self.limits,
-                spec=self.spec, lanes_per_contract=self.lanes_per_contract,
-                max_steps=self.max_steps,
-                solver_iters=self.solver_iters,
-                solver_timeout=self.solver_timeout,
-                transaction_count=self.transaction_count,
-                plugins=self.plugins,
-                enable_iprof=self.enable_iprof,
-            )
-            report = fire_lasers(sym, white_list=self.modules,
-                                 parallel=self.parallel_solving)
+            out = self._run_batch_resilient(bi, batch)
             dt = time.monotonic() - t0
-            cov = sym.coverage
-            for issue in report.issues:
-                if issue.contract.startswith("_pad_"):
-                    continue
-                d = issue.as_dict()
-                d["batch"] = bi
-                res.issues.append(d)
+            res.issues.extend(out["issues"])
             res.batch_wall.append(dt)
-            res.paths_total += int(cov.get("surviving_paths", 0))
-            res.dropped_forks += int(cov.get("dropped_forks", 0))
-            if self.enable_iprof:
-                for name, n in sym.iprof.items():
-                    res.iprof[name] = res.iprof.get(name, 0) + n
+            res.paths_total += out["paths"]
+            res.dropped_forks += out["dropped"]
+            for name, n in out["iprof"].items():
+                res.iprof[name] = res.iprof.get(name, 0) + n
+            res.quarantined.extend(out["quarantined"])
+            res.retries += out["retries"]
+            res.batch_status.append(out["status"])
             sess = SOLVER_STATS.delta(stats_at_start)
             state.update(next_batch=bi + 1, issues=res.issues,
                          batch_wall=res.batch_wall,
                          paths_total=res.paths_total,
                          dropped_forks=res.dropped_forks,
                          iprof=res.iprof,
+                         quarantined=res.quarantined,
+                         retries=res.retries,
+                         batch_status=res.batch_status,
+                         backend_events=session_events(),
                          solver={k: round(solver_prior.get(k, 0) + v, 3)
                                  for k, v in sess.items()})
             self._save_ckpt(state)
@@ -291,6 +460,7 @@ class CorpusCampaign:
         res.contracts = min(res.batches * self.batch_size, len(self.contracts))
         res.wall_sec = time.monotonic() - t_start
         res.compile_sec = res.batch_wall[0] if res.batch_wall else 0.0
+        res.backend_events = session_events()
         sess = SOLVER_STATS.delta(stats_at_start)
         res.solver = {k: round(solver_prior.get(k, 0) + v, 3)
                       for k, v in sess.items()}
@@ -311,6 +481,15 @@ def merge_campaigns(results: Sequence[Dict]) -> Dict:
                         default=0.0),
         "paths_total": sum(r.get("paths_total", 0) for r in results),
         "dropped_forks": sum(r.get("dropped_forks", 0) for r in results),
+        # resilience fields: quarantine entries already carry their host's
+        # batch index; concatenation in input order keeps them auditable
+        "quarantined": [q for r in results
+                        for q in (r.get("quarantined") or [])],
+        "retries": sum(r.get("retries", 0) for r in results),
+        "batch_status": [s for r in results
+                         for s in (r.get("batch_status") or [])],
+        "backend_events": [e for r in results
+                           for e in (r.get("backend_events") or [])],
     }
     wall = merged["wall_sec"]
     merged["contracts_per_sec"] = (

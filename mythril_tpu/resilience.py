@@ -1,0 +1,319 @@
+"""Fault-isolated execution: watchdogs, fault injection, backend management.
+
+The pod-scale north star (10k contracts in minutes, ROADMAP) is only as
+strong as its weakest failure mode, and this repo has hit every one of
+them on real hardware:
+
+- a wedged TPU runtime hangs ``jax.devices()`` forever
+  (``docs/tpu-wedge-round5.md`` — two multi-hour wedges, round 4 + 5);
+- a hung XLA compile can exceed any outer budget (round 4: >580 s for
+  one cold-cache program through the axon tunnel);
+- one pathological contract can stall or crash a whole campaign batch,
+  and ``CorpusCampaign.run`` only checked its deadline *between*
+  batches.
+
+This module is the shared answer (DTVM's fault-contained-execution
+property, PAPERS.md; EVMx assumes a host-side supervisor that survives
+device faults):
+
+- :func:`run_with_watchdog` — run a callable under a hard wall-clock
+  deadline in a worker thread; expiry raises :class:`BatchTimeout`
+  instead of stalling the supervisor (the stuck thread is abandoned,
+  exactly like bench.py abandons an unkillable D-state probe child).
+- :class:`FaultInjector` — deterministic, env/constructor-driven fault
+  injection (hang / raise / device-lost / kill at a batch index or on a
+  contract name) so every recovery path is testable on CPU.
+- :class:`BackendManager` — subprocess-isolated backend probe with a
+  timeout, bounded re-init attempts with backoff, and an explicit CPU
+  fallback, all recorded as structured events for the campaign report.
+  Generalizes ``bench.py``'s ad-hoc ``_probe_backend``.
+
+IMPORTANT: nothing in this module may touch a JAX backend at import or
+probe time — the whole point is to stay alive when the backend is the
+thing that is wedged. The probe runs ``jax.devices()`` in a *child*
+process only.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class ResilienceError(RuntimeError):
+    """Base for supervisor-level failures."""
+
+
+class BatchTimeout(ResilienceError):
+    """A watchdogged unit of work exceeded its wall-clock budget."""
+
+
+class DeviceLostError(ResilienceError):
+    """The accelerator went away mid-run (injected or detected)."""
+
+
+class InjectedKill(BaseException):
+    """Simulates SIGKILL mid-batch for kill/resume testing.
+
+    Deliberately a ``BaseException``: the campaign's retry/bisect
+    machinery catches ``Exception`` — a simulated kill must blow through
+    it uncheckpointed, exactly like a real SIGKILL would.
+    """
+
+
+# --- watchdog ---------------------------------------------------------
+
+
+def run_with_watchdog(fn: Callable, timeout: Optional[float],
+                      label: str = "work"):
+    """Run ``fn()`` under a hard wall-clock deadline.
+
+    ``timeout=None`` runs inline (no thread). Otherwise the work runs in
+    a daemon thread; if it has not finished after ``timeout`` seconds a
+    :class:`BatchTimeout` is raised and the thread is ABANDONED — a hung
+    XLA compile or wedged device call cannot be interrupted from Python,
+    so the supervisor walks away from it (the abandoned thread dies with
+    the process; an injected hang just sleeps). Exceptions from ``fn``
+    (including ``BaseException``s like :class:`InjectedKill`) re-raise
+    in the caller.
+    """
+    if timeout is None:
+        return fn()
+    box: Dict[str, object] = {}
+    done = threading.Event()
+
+    def work():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=work, daemon=True,
+                         name=f"watchdog:{label}")
+    t.start()
+    if not done.wait(timeout):
+        raise BatchTimeout(
+            f"{label} exceeded {timeout:.1f}s wall-clock budget")
+    if "error" in box:
+        raise box["error"]  # type: ignore[misc]
+    return box.get("value")
+
+
+# --- fault injection --------------------------------------------------
+
+FAULT_MODES = ("hang", "raise", "device-lost", "kill")
+
+#: how long an injected hang sleeps per check; the watchdog is expected
+#: to fire long before the total (a daemon thread naps harmlessly after)
+_HANG_TOTAL_S = 3600.0
+
+
+@dataclass
+class FaultSpec:
+    """One trigger: ``mode`` fires when the batch index and/or contract
+    name matches, at most ``times`` times (None = every time — a
+    persistent poison; ``times=1`` models a transient fault the
+    retry-once policy cures)."""
+
+    mode: str
+    batch: Optional[int] = None
+    contract: Optional[str] = None
+    times: Optional[int] = None
+    fired: int = 0
+
+    def matches(self, batch: Optional[int],
+                contracts: Sequence[str]) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.batch is not None and batch != self.batch:
+            return False
+        if self.contract is not None and self.contract not in contracts:
+            return False
+        return True
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        """``mode[:key=value]*`` — e.g. ``raise:contract=c002``,
+        ``hang:batch=1``, ``raise:batch=0:times=1``, ``kill:batch=2``."""
+        parts = [p for p in text.strip().split(":") if p]
+        if not parts or parts[0] not in FAULT_MODES:
+            raise ValueError(
+                f"fault spec {text!r}: mode must be one of {FAULT_MODES}")
+        spec = cls(mode=parts[0])
+        for kv in parts[1:]:
+            if "=" not in kv:
+                raise ValueError(f"fault spec {text!r}: expected key=value, "
+                                 f"got {kv!r}")
+            k, v = kv.split("=", 1)
+            if k == "batch":
+                spec.batch = int(v)
+            elif k == "contract":
+                spec.contract = v
+            elif k == "times":
+                spec.times = int(v)
+            else:
+                raise ValueError(f"fault spec {text!r}: unknown key {k!r}")
+        if spec.batch is None and spec.contract is None:
+            raise ValueError(
+                f"fault spec {text!r}: need batch= and/or contract= "
+                "(an unconditional fault would poison every batch)")
+        return spec
+
+
+class FaultInjector:
+    """Deterministic fault source, checked at the top of every guarded
+    batch attempt. Specs parse from a ``;``-separated string — the
+    ``MYTHRIL_FAULT_INJECT`` env var or ``--fault-inject`` — or are
+    built directly. The log of fires is kept for test assertions."""
+
+    def __init__(self, specs: Sequence[FaultSpec] = ()):
+        self.specs = list(specs)
+        self.log: List[Dict] = []
+
+    @classmethod
+    def from_string(cls, text: Optional[str]) -> Optional["FaultInjector"]:
+        if not text:
+            return None
+        return cls([FaultSpec.parse(p)
+                    for p in text.split(";") if p.strip()])
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultInjector"]:
+        return cls.from_string(os.environ.get("MYTHRIL_FAULT_INJECT"))
+
+    def fire(self, batch: Optional[int] = None,
+             contracts: Sequence[str] = ()) -> None:
+        """Raise/hang per the first matching spec (called INSIDE the
+        watchdog, so a hang surfaces as :class:`BatchTimeout`)."""
+        for spec in self.specs:
+            if not spec.matches(batch, contracts):
+                continue
+            spec.fired += 1
+            self.log.append({"mode": spec.mode, "batch": batch,
+                             "contracts": list(contracts)})
+            if spec.mode == "hang":
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < _HANG_TOTAL_S:
+                    time.sleep(0.05)
+                return
+            if spec.mode == "raise":
+                raise ResilienceError(
+                    f"injected fault (batch={batch}, "
+                    f"contracts={list(contracts)})")
+            if spec.mode == "device-lost":
+                raise DeviceLostError(
+                    f"injected device loss (batch={batch})")
+            if spec.mode == "kill":
+                raise InjectedKill(
+                    f"injected kill (batch={batch})")
+
+
+# --- backend management ----------------------------------------------
+
+
+class BackendManager:
+    """Probe/recover the JAX backend without ever letting a wedge reach
+    this process: the probe child runs ``jax.devices()`` and is
+    abandoned (not waited on) if it hangs — a child wedged in an
+    uninterruptible driver call survives SIGKILL (round-3/5 evidence).
+
+    ``probe_fn`` swaps the subprocess probe for a callable
+    ``(timeout_s) -> (ok, diag)`` in tests. Every attempt, backoff, and
+    fallback lands in ``events`` (list of dicts) so campaign reports
+    and bench records carry the full backend story.
+    """
+
+    def __init__(self, init_timeout: float = 75.0, max_attempts: int = 2,
+                 backoff: float = 5.0,
+                 probe_fn: Optional[Callable[[float], Tuple[bool, str]]] = None):
+        self.init_timeout = init_timeout
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff = backoff
+        self.probe_fn = probe_fn
+        self.events: List[Dict] = []
+
+    def _event(self, kind: str, detail: str = "", attempt: int = 0) -> None:
+        self.events.append({"kind": kind, "detail": detail[:300],
+                            "attempt": attempt,
+                            "t": round(time.time(), 3)})
+
+    def _subprocess_probe(self, timeout_s: float) -> Tuple[bool, str]:
+        """One isolated backend init (lifted from bench.py's round-3
+        hardening). Returns (ok, diagnosis)."""
+        import tempfile
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with tempfile.TemporaryFile(mode="w+") as out:
+            p = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import sys; sys.path.insert(0, %r); " % root
+                 + "import mythril_tpu, jax; d = jax.devices(); "
+                   "print('OK', jax.default_backend(), len(d))"],
+                stdout=out, stderr=subprocess.STDOUT,
+            )
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if p.poll() is not None:
+                    break
+                time.sleep(0.2)
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass  # unkillable (D-state): abandon it
+                return False, f"backend init hung >{timeout_s:.0f}s"
+            out.seek(0)
+            text = out.read()
+            if p.returncode == 0 and "OK" in text:
+                return True, text.strip().splitlines()[-1]
+            return False, "backend init failed (rc=%s): %s" % (
+                p.returncode, text.strip()[-300:])
+
+    def probe(self) -> Tuple[bool, str]:
+        """Bounded re-init attempts with backoff between them."""
+        probe = self.probe_fn or self._subprocess_probe
+        diag = "no probe attempt made"
+        for attempt in range(1, self.max_attempts + 1):
+            ok, diag = probe(self.init_timeout)
+            self._event("probe_ok" if ok else "probe_fail", diag, attempt)
+            if ok:
+                return True, diag
+            if attempt < self.max_attempts and self.backoff > 0:
+                # linear backoff: a wedged runtime sometimes clears after
+                # the stuck client's grpc deadline lapses
+                time.sleep(self.backoff * attempt)
+        return False, diag
+
+    def ensure_or_fallback(self) -> Tuple[bool, str]:
+        """Probe; on failure pin this process to the CPU backend via
+        JAX_PLATFORMS (heavy engine imports must not have run yet) and
+        record an explicit ``cpu_fallback`` event. Returns
+        (backend_ok, diagnosis)."""
+        ok, diag = self.probe()
+        if ok:
+            return True, diag
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        self._event("cpu_fallback",
+                    "configured backend unreachable; JAX_PLATFORMS=cpu")
+        return False, diag
+
+    def recover(self, reason: str = "device-lost") -> bool:
+        """After a device loss mid-campaign: record it, re-probe with the
+        usual bounded attempts. Returns whether the backend answered."""
+        self._event("device_lost", reason)
+        ok, _ = self.probe()
+        return ok
+
+
+__all__ = [
+    "BackendManager", "BatchTimeout", "DeviceLostError", "FaultInjector",
+    "FaultSpec", "InjectedKill", "ResilienceError", "run_with_watchdog",
+]

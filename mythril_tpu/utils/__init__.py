@@ -1,1 +1,18 @@
 """Cross-cutting support (reference: ``mythril/support/`` ⚠unv)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_write_json(path: str, obj, indent: int | None = None) -> None:
+    """Write JSON via a pid-suffixed temp file + ``os.replace``: a
+    mid-write kill can never truncate the target, and concurrent
+    writers cannot collide on the temp file (last-replace-wins). Shared
+    by the campaign checkpoint, the profiler's measurement history, and
+    the soak tool."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh, indent=indent)
+    os.replace(tmp, path)

@@ -93,6 +93,44 @@ def test_malformed_rpc_response_degrades_not_crashes(tmp_path):
     assert fire_lasers(sym).issues is not None      # analysis completed
 
 
+class _CountingClient:
+    """Records every eth_getCode address; never returns code."""
+
+    def __init__(self):
+        self.requests = []
+
+    def eth_getCode(self, address):
+        self.requests.append(address)
+        return "0x"
+
+    def eth_getStorageAt(self, address, slot):
+        return "0x" + "00" * 32
+
+
+# mutate, then CALL the identity precompile (address 0x4) — a concrete
+# in-range target that must NEVER be fetched over RPC (ADVICE r5: junk
+# and precompile addresses were burning the 4-slot dynld budget)
+PRECOMPILE_CALLER = assemble(
+    1, 0, "SSTORE",
+    0, 0, 0, 0, 0,
+    4, "GAS", "CALL", "POP", "STOP",
+)
+
+
+def test_precompile_addresses_never_harvested():
+    client = _CountingClient()
+    sym = SymExecWrapper(
+        [PRECOMPILE_CALLER], limits=L, lanes_per_contract=8, max_steps=96,
+        transaction_count=2, dyn_loader=DynLoader(client),
+    )
+    assert client.requests == [], \
+        f"precompile fetch attempted: {client.requests}"
+    assert sym.dynld_loaded == []
+    # nor should 0x4 occupy a permanent-miss slot: it was filtered, not
+    # tried-and-missed
+    assert 4 not in sym._dynld_miss
+
+
 def test_dynld_misses_are_cached(tmp_path):
     # empty chain DB: the fetch misses; the address must enter the miss
     # cache and not be refetched (FileRpcClient has no call counter, so

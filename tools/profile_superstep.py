@@ -23,6 +23,22 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+# Optional backend gate (PROF_INIT_TIMEOUT=<sec>): probe backend init in
+# a subprocess BEFORE the heavy imports below build jnp tables — on a
+# wedged TPU runtime those imports hang this process forever
+# (docs/tpu-wedge-round5.md). bench.py probes on its own before spawning
+# this tool, so the gate is opt-in to avoid double-probing.
+_INIT_TIMEOUT = float(os.environ.get("PROF_INIT_TIMEOUT", "0") or 0)
+if _INIT_TIMEOUT > 0:
+    from mythril_tpu.resilience import BackendManager
+
+    _bm = BackendManager(init_timeout=_INIT_TIMEOUT)
+    _ok, _diag = _bm.probe()
+    if not _ok:
+        print(json.dumps({"error": "backend unavailable: " + _diag,
+                          "backend_events": _bm.events}))
+        sys.exit(1)
+
 import mythril_tpu  # noqa: F401
 import jax
 import jax.numpy as jnp
@@ -228,10 +244,9 @@ def main():
         # truncate the history and parallel writers cannot collide on
         # the temp file (TPU runs are serialized by the one-chip policy,
         # so last-replace-wins is acceptable for the merge itself)
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as fh:
-            json.dump(hist, fh, indent=1)
-        os.replace(tmp, path)
+        from mythril_tpu.utils import atomic_write_json
+
+        atomic_write_json(path, hist, indent=1)
 
 
 if __name__ == "__main__":

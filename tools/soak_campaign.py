@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Resilience soak smoke: a small corpus with injected faults, end to
+end on the CPU backend.
+
+Three legs, one process (see docs/resilience.md):
+
+  1. transient — a raise fault at batch 0 with ``times=1``; the
+     retry-once policy must cure it with nothing quarantined;
+  2. poison — a persistent raise fault on one contract; the campaign
+     must bisect, quarantine exactly that contract, and finish every
+     other batch;
+  3. kill+resume — a simulated SIGKILL (InjectedKill) mid-campaign on
+     top of the poison; the resumed session must converge to the same
+     final issue set and quarantine list as leg 2.
+
+Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
+suitable as a CI smoke or a manual post-change sanity run:
+
+    JAX_PLATFORMS=cpu python tools/soak_campaign.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# the soak is a CPU functional check; never let it touch (and possibly
+# wedge on) a configured accelerator backend
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mythril_tpu  # noqa: E402,F401  (enables x64)
+from mythril_tpu.config import TEST_LIMITS  # noqa: E402
+from mythril_tpu.disassembler.asm import assemble  # noqa: E402
+from mythril_tpu.mythril.campaign import (  # noqa: E402
+    CorpusCampaign, load_corpus_dir)
+from mythril_tpu.resilience import (  # noqa: E402
+    FaultInjector, InjectedKill)
+
+KILLABLE = assemble(0, "SELFDESTRUCT")
+SAFE = assemble(1, 0, "SSTORE", "STOP")
+N = 6  # even indices killable -> expected issues c000/c002/c004
+
+
+def write_corpus(d: str) -> str:
+    corpus = os.path.join(d, "corpus")
+    os.makedirs(corpus, exist_ok=True)
+    for i in range(N):
+        code = KILLABLE if i % 2 == 0 else SAFE
+        with open(os.path.join(corpus, f"c{i:03d}.hex"), "w") as fh:
+            fh.write(code.hex())
+    return corpus
+
+
+def campaign(corpus: str, ckpt: str, fault: str | None):
+    return CorpusCampaign(
+        load_corpus_dir(corpus),
+        batch_size=4, lanes_per_contract=8, limits=TEST_LIMITS,
+        max_steps=64, transaction_count=1,
+        modules=["AccidentallyKillable"], checkpoint_dir=ckpt,
+        batch_timeout=300.0,  # generous: guards the soak, not the test
+        fault_injector=FaultInjector.from_string(fault),
+    )
+
+
+def main() -> int:
+    legs: dict = {}
+    ok = True
+    with tempfile.TemporaryDirectory() as d:
+        corpus = write_corpus(d)
+
+        # leg 1: transient fault cured by the retry-once policy
+        r = campaign(corpus, os.path.join(d, "ck1"),
+                     "raise:batch=0:times=1").run()
+        legs["transient"] = {"retries": r.retries,
+                             "quarantined": len(r.quarantined),
+                             "issues": len(r.issues)}
+        ok &= (r.retries == 1 and not r.quarantined
+               and len(r.issues) == 3)
+
+        # leg 2: persistent poison -> bisect -> quarantine, run survives
+        r2 = campaign(corpus, os.path.join(d, "ck2"),
+                      "raise:contract=c002").run()
+        legs["poison"] = {"quarantined": [q["name"] for q in r2.quarantined],
+                          "batch_status": r2.batch_status,
+                          "issues": sorted(i["contract"] for i in r2.issues)}
+        ok &= ([q["name"] for q in r2.quarantined] == ["c002"]
+               and legs["poison"]["issues"] == ["c000", "c004"])
+
+        # leg 3: kill mid-campaign, then resume to the same final state
+        ck3 = os.path.join(d, "ck3")
+        killed = False
+        try:
+            campaign(corpus, ck3, "raise:contract=c002;kill:batch=1").run()
+        except InjectedKill:
+            killed = True
+        r3 = campaign(corpus, ck3, "raise:contract=c002").run()
+        legs["kill_resume"] = {
+            "killed": killed,
+            "batches": r3.batches,
+            "quarantined": [q["name"] for q in r3.quarantined],
+            "issues": sorted(i["contract"] for i in r3.issues)}
+        ok &= (killed and r3.batches == 2
+               and legs["kill_resume"]["quarantined"] == ["c002"]
+               and legs["kill_resume"]["issues"] == legs["poison"]["issues"])
+
+    print(json.dumps({"ok": bool(ok), "legs": legs}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
