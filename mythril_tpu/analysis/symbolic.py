@@ -484,8 +484,15 @@ class SymExecWrapper:
                 import jax.numpy as jnp
                 self._iprof += np.asarray(sf.base.op_hist).sum(
                     axis=0, dtype=np.int64)
-                sf = sf.replace(base=sf.base.replace(
-                    op_hist=jnp.zeros_like(sf.base.op_hist)))
+                repl = {"op_hist": jnp.zeros_like(sf.base.op_hist)}
+                if sf.base.op_resid is not None:
+                    # residual sidecar: retired lanes' counts orphaned
+                    # by slot recycling / lane movement since the last
+                    # harvest (per-lane rows stay attributable)
+                    self._iprof += np.asarray(
+                        sf.base.op_resid).astype(np.int64)
+                    repl["op_resid"] = jnp.zeros_like(sf.base.op_resid)
+                sf = sf.replace(base=sf.base.replace(**repl))
             self.plugin_loader.fire("on_tx_end", ctx)
             if not is_last:
                 if self.dyn_loader is not None:

@@ -65,6 +65,14 @@ class ResilienceConfig:
     max_batch_retries: int = 1          # re-attempts before bisection
     probe_attempts: int = 2             # backend re-init attempts
     probe_backoff: float = 5.0          # seconds between probe attempts
+    # RESOURCE_EXHAUSTED degradation ladder, walked in order and
+    # cumulatively (see resilience.DEGRADE_RUNGS / docs/resilience.md):
+    # shrink the work until the batch fits instead of aborting the run
+    oom_ladder: tuple = ("halve-lanes", "halve-batch", "cpu")
+    # batches between durable campaign-checkpoint writes (1 = every
+    # batch — kill -9 at any instant loses at most one batch; larger
+    # values trade replayed batches for less checkpoint I/O)
+    checkpoint_every: int = 1
 
 
 DEFAULT_RESILIENCE = ResilienceConfig()

@@ -208,6 +208,16 @@ class Frontier:
     # superstep, expand_forks zeroes copies' rows (a fork child inherits
     # its parent's PATH, not its parent's executed instructions).
     op_hist: Optional[jnp.ndarray] = None
+    # residual sidecar for op_hist (ADVICE r5): when slot recycling
+    # (expand_forks) or lane movement (rebalance/migrate) would orphan a
+    # retired lane's not-yet-harvested rows, they accumulate HERE — a
+    # lane-independent i32[256] — instead of being folded into an
+    # arbitrary live lane's row, so per-lane consumers of op_hist stay
+    # attributable. Harvest = sum(op_hist rows) + op_resid; both zero
+    # together at tx boundaries. None whenever op_hist is None (legacy
+    # hand-built frontiers with op_hist but no sidecar keep the old
+    # fold-into-a-live-lane behavior).
+    op_resid: Optional[jnp.ndarray] = None
 
     @property
     def n_lanes(self) -> int:
@@ -230,9 +240,11 @@ class Frontier:
         return (self.init_depth > 0) & (self.depth == self.init_depth)
 
     def attach_iprof(self) -> "Frontier":
-        """Enable the per-opcode instruction profiler (zeroed histogram)."""
+        """Enable the per-opcode instruction profiler (zeroed per-lane
+        histogram + zeroed residual sidecar row)."""
         return self.replace(
-            op_hist=jnp.zeros((self.n_lanes, 256), dtype=jnp.int32))
+            op_hist=jnp.zeros((self.n_lanes, 256), dtype=jnp.int32),
+            op_resid=jnp.zeros(256, dtype=jnp.int32))
 
     def trap(self, mask, code: int) -> "Frontier":
         """Set the error flag under ``mask``, attributing the FIRST cause."""

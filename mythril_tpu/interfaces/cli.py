@@ -143,8 +143,23 @@ def create_parser() -> argparse.ArgumentParser:
                    help="campaign mode (testing): inject deterministic "
                         "faults, e.g. 'raise:contract=c002', "
                         "'hang:batch=1', 'raise:batch=0:times=1', "
-                        "'kill:batch=2'; ';'-separated specs; the "
-                        "MYTHRIL_FAULT_INJECT env var is equivalent")
+                        "'kill:batch=2', 'oom:batch=1:times=2'; "
+                        "';'-separated specs; the MYTHRIL_FAULT_INJECT "
+                        "env var is equivalent")
+    a.add_argument("--oom-ladder", metavar="LIST",
+                   default=None,
+                   help="campaign mode: comma-separated degradation "
+                        "rungs walked (cumulatively) when a batch hits "
+                        "RESOURCE_EXHAUSTED, from 'halve-lanes', "
+                        "'halve-batch', 'cpu' (default: all three in "
+                        "that order); 'none' disables degradation — an "
+                        "OOM then falls to retry/bisect")
+    a.add_argument("--checkpoint-every", type=int, default=1,
+                   metavar="N",
+                   help="campaign mode: durable checkpoint write every "
+                        "N batches (default 1 — kill -9 at any instant "
+                        "loses at most one batch; larger N trades "
+                        "replayed batches for less checkpoint I/O)")
     a.add_argument("--num-hosts", type=int, default=0, metavar="N",
                    help="campaign mode: shard the corpus across N hosts; "
                         "this process analyzes slice --host-index "
@@ -446,7 +461,13 @@ def _exec_campaign(args) -> int:
     import json
 
     from ..config import DEFAULT_RESILIENCE
-    from ..resilience import BackendManager, FaultInjector
+    from ..resilience import BackendManager, FaultInjector, parse_ladder
+
+    try:
+        oom_ladder = parse_ladder(args.oom_ladder)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(2)
 
     # backend probe FIRST, while this process is still backend-free: a
     # wedged TPU runtime hangs jax.devices() forever (docs/
@@ -497,6 +518,8 @@ def _exec_campaign(args) -> int:
         max_batch_retries=args.max_batch_retries,
         fault_injector=FaultInjector.from_string(args.fault_inject),
         backend=backend,
+        oom_ladder=oom_ladder,
+        checkpoint_every=args.checkpoint_every,
     )
 
     def progress(done, total, dt, n_issues):

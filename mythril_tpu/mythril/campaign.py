@@ -8,36 +8,42 @@ streams fixed-shape BATCHES of contracts through ONE compiled program:
 - every batch has exactly ``batch_size`` contracts x ``lanes_per_contract``
   lanes (short batches pad with a STOP stub), so XLA compiles once and
   every subsequent batch replays the cached executable;
-- a JSON checkpoint (issues + batch cursor) lands after every batch;
-  resume skips completed batches — a killed 10k-contract run loses at
-  most one batch of work;
+- a durable JSON checkpoint (issues + batch cursor; checksummed,
+  rotated — docs/checkpointing.md) lands every ``checkpoint_every``
+  batches (default: every batch); resume verifies it, falls back to
+  the rotated copy if the newest write was torn, and skips completed
+  batches — a killed 10k-contract run loses at most one cadence of
+  work even when the kill lands mid-checkpoint-write;
 - the campaign report carries the BASELINE metrics: contracts/sec,
   paths/sec, issues, solver statistics, per-batch wall times;
 - execution is fault-isolated (docs/resilience.md): each batch runs
-  under an optional wall-clock watchdog, a failed batch is retried then
-  BISECTED so poison contracts are quarantined individually, and
-  backend loss degrades through bounded re-probes to an explicit CPU
-  fallback — a 10k campaign loses at most the poison contracts.
+  under an optional wall-clock watchdog, a RESOURCE_EXHAUSTED batch
+  walks the degradation ladder (halve lanes → halve batch width → CPU)
+  instead of failing, any other failure is retried then BISECTED so
+  poison contracts are quarantined individually, and backend loss
+  degrades through bounded re-probes to an explicit CPU fallback — a
+  10k campaign loses at most the poison contracts.
 
 CLI: ``python -m mythril_tpu analyze --corpus DIR`` (see interfaces/cli).
 """
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import time
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 if TYPE_CHECKING:  # import is heavy at runtime (engine); lazy below
     from ..symbolic import SymSpec
 
 from ..config import DEFAULT_LIMITS, DEFAULT_RESILIENCE, LimitsConfig
 from ..resilience import (BackendManager, BatchTimeout, DeviceLostError,
-                          FaultInjector, run_with_watchdog)
-from ..utils import atomic_write_json
+                          FaultInjector, classify_backend_error,
+                          run_with_watchdog)
+from ..utils.checkpoint import (load_json_checkpoint_resilient,
+                                save_json_checkpoint)
 
 # NOTE: no engine imports at module level — ``campaign-merge`` (pure
 # dict math over per-host JSONs) must be runnable without initializing a
@@ -160,6 +166,8 @@ class CorpusCampaign:
         fault_injector: Optional[FaultInjector] = None,
         backend: Optional[BackendManager] = None,
         batch_runner=None,
+        oom_ladder: Optional[Sequence[str]] = None,
+        checkpoint_every: int = DEFAULT_RESILIENCE.checkpoint_every,
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -208,6 +216,30 @@ class CorpusCampaign:
                                else FaultInjector.from_env())
         self.backend = backend
         self._batch_runner = batch_runner
+        # a stub runner that doesn't understand degraded capacity still
+        # exercises the ladder's control flow (events, statuses); only
+        # runners declaring lanes/width actually shrink the work
+        self._runner_degradable = True
+        if batch_runner is not None:
+            import inspect
+
+            try:
+                params = inspect.signature(batch_runner).parameters
+                self._runner_degradable = (
+                    "lanes" in params or "width" in params
+                    or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                           for p in params.values()))
+            except (TypeError, ValueError):
+                self._runner_degradable = False
+        # RESOURCE_EXHAUSTED degradation ladder (docs/resilience.md):
+        # rung names from resilience.DEGRADE_RUNGS, walked in order,
+        # cumulatively; () disables (an OOM then falls to retry/bisect)
+        self.oom_ladder = tuple(DEFAULT_RESILIENCE.oom_ladder
+                                if oom_ladder is None else oom_ladder)
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        # campaign-level structured events (degradation steps, checkpoint
+        # recoveries) — merged with the BackendManager's into the report
+        self._events: List[Dict] = []
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -218,11 +250,29 @@ class CorpusCampaign:
                 else f"campaign_host{self.host_index}.json")
         return os.path.join(self.checkpoint_dir, name)
 
+    def _event(self, kind: str, detail: str = "", **kw) -> None:
+        e = {"kind": kind, "detail": detail[:300],
+             "t": round(time.time(), 3)}
+        e.update(kw)
+        self._events.append(e)
+
     def _load_ckpt(self) -> Dict:
         p = self._ckpt_path
-        if p and os.path.exists(p):
-            with open(p) as fh:
-                state = json.load(fh)
+        state = None
+        if p is not None:
+            # verified load with fallback: a torn newest file (kill -9
+            # mid-write) degrades to the rotated last-known-good copy —
+            # costing at most the batches since that copy, never the run
+            state, src = load_json_checkpoint_resilient(p)
+            if state is not None and src != p:
+                self._event("checkpoint_recovered", detail=src)
+            elif state is None and os.path.exists(p + ".corrupt"):
+                # newest corrupt (quarantined aside) and nothing
+                # rotated: the torn file was the first checkpoint ever,
+                # so no completed batch was durably recorded — a fresh
+                # start replays only batch 0
+                self._event("checkpoint_reset", detail=p)
+        if state is not None:
             # a checkpoint taken under a different sharding (or corpus)
             # indexes a DIFFERENT contract slice — resuming it would
             # silently skip contracts and double-attribute issues
@@ -253,27 +303,37 @@ class CorpusCampaign:
         if p is None:
             return
         os.makedirs(self.checkpoint_dir, exist_ok=True)
-        atomic_write_json(p, state)  # a crash never corrupts the cursor
+        # checksummed + fsynced + rotated: a crash never corrupts the
+        # cursor, and even a torn rename leaves <p>.1 loadable
+        save_json_checkpoint(p, state)
 
     # --- one engine pass -----------------------------------------------
-    def _exec_batch(self, bi: int, names: List[str],
-                    codes: List[bytes]) -> Dict:
+    def _exec_batch(self, bi: int, names: List[str], codes: List[bytes],
+                    lanes: Optional[int] = None,
+                    width: Optional[int] = None) -> Dict:
         """Analyze one (padded) batch; returns the batch's partial
         results. This is the unit of work the watchdog guards and the
-        bisection replays on sub-batches — always padded to
-        ``batch_size`` so every attempt replays the ONE compiled
-        engine."""
+        bisection replays on sub-batches — always padded to ``width``
+        (default ``batch_size``) so every attempt at a given rung
+        replays ONE compiled engine. ``lanes``/``width`` below their
+        defaults are the degradation ladder shrinking the working set:
+        a smaller shape is a new (cheaper) compile, and the tighter
+        fork capacity is absorbed by the engine's park/spill machinery
+        (``defer_starved`` + rebalance) instead of dropping paths."""
         from ..analysis import SymExecWrapper, fire_lasers
 
+        width = self.batch_size if width is None else width
         names = list(names)
         codes = list(codes)
         # constant compiled shape: pad short batches with STOP stubs
-        while len(codes) < self.batch_size:
+        while len(codes) < width:
             names.append(f"_pad_{len(codes)}")
             codes.append(_PAD_BYTECODE)
         sym = SymExecWrapper(
             codes, contract_names=names, limits=self.limits,
-            spec=self.spec, lanes_per_contract=self.lanes_per_contract,
+            spec=self.spec,
+            lanes_per_contract=(self.lanes_per_contract
+                                if lanes is None else lanes),
             max_steps=self.max_steps,
             solver_iters=self.solver_iters,
             solver_timeout=self.solver_timeout,
@@ -299,18 +359,45 @@ class CorpusCampaign:
         }
 
     # --- fault isolation ----------------------------------------------
-    def _guarded_batch(self, bi: int, items: Sequence[tuple]) -> Dict:
+    @staticmethod
+    def _cpu_device():
+        """``jax.default_device`` context pinning execution to the host
+        CPU backend, or None when no CPU device is available (then the
+        rung degenerates to a plain replay). Imported lazily — the
+        campaign must stay importable without initializing a backend."""
+        try:
+            import jax
+
+            return jax.default_device(jax.devices("cpu")[0])
+        except Exception:  # noqa: BLE001 — no backend / no cpu plugin
+            return None
+
+    def _guarded_batch(self, bi: int, items: Sequence[tuple],
+                       lanes: Optional[int] = None,
+                       width: Optional[int] = None,
+                       on_cpu: bool = False) -> Dict:
         """One attempt: fault-injection check + engine pass, under the
         wall-clock watchdog. A hung compile / wedged device call
-        surfaces as BatchTimeout here instead of stalling the run."""
+        surfaces as BatchTimeout here instead of stalling the run.
+        ``lanes``/``width``/``on_cpu`` carry the degradation rung."""
         names = [n for n, _ in items]
         codes = [c for _, c in items]
+
+        def call_runner():
+            runner = self._batch_runner or self._exec_batch
+            if self._batch_runner is not None and not self._runner_degradable:
+                return runner(bi, names, codes)
+            return runner(bi, names, codes, lanes=lanes, width=width)
 
         def work():
             if self.fault_injector is not None:
                 self.fault_injector.fire(batch=bi, contracts=names)
-            runner = self._batch_runner or self._exec_batch
-            return runner(bi, names, codes)
+            if on_cpu:
+                cm = self._cpu_device()
+                if cm is not None:
+                    with cm:
+                        return call_runner()
+            return call_runner()
 
         return run_with_watchdog(work, self.batch_timeout,
                                  label=f"batch {bi}")
@@ -329,16 +416,71 @@ class CorpusCampaign:
         if isinstance(e, DeviceLostError) and self.backend is not None:
             self.backend.recover(reason=str(e)[:200])
 
+    def _degrade_batch(self, bi: int, items: Sequence[tuple],
+                       first_err: BaseException) -> Tuple[Dict, str]:
+        """Walk the RESOURCE_EXHAUSTED ladder until the batch fits.
+
+        Rungs apply cumulatively — halve the per-contract lanes, then
+        additionally halve the batch width (the batch replays as
+        half-width sub-batches, each padded to the new shape), then
+        additionally pin execution to the CPU backend. Every step lands
+        in the report's ``backend_events``; a rung that fails with a
+        NON-OOM error re-raises immediately (that failure belongs to
+        the retry/bisect machinery, not the ladder). Partial sub-batch
+        results are discarded on a failed rung so nothing is counted
+        twice when the next rung replays the whole batch. Returns
+        ``(results, rung)`` of the first rung that completed; raises the
+        last OOM when the ladder is exhausted."""
+        lanes = self.lanes_per_contract
+        width = self.batch_size
+        on_cpu = False
+        err = first_err
+        for rung in self.oom_ladder:
+            if rung == "halve-lanes":
+                lanes = max(1, lanes // 2)
+            elif rung == "halve-batch":
+                width = max(1, width // 2)
+            elif rung == "cpu":
+                on_cpu = True
+            self._event("degrade", detail=self._fault_reason(err),
+                        batch=bi, step=rung, lanes=lanes, width=width)
+            try:
+                out = {"issues": [], "paths": 0, "dropped": 0, "iprof": {}}
+                for k in range(0, len(items), width):
+                    r = self._guarded_batch(bi, items[k:k + width],
+                                            lanes=lanes, width=width,
+                                            on_cpu=on_cpu)
+                    out["issues"].extend(r["issues"])
+                    out["paths"] += r["paths"]
+                    out["dropped"] += r["dropped"]
+                    for op, n in r["iprof"].items():
+                        out["iprof"][op] = out["iprof"].get(op, 0) + n
+                self._event("degrade_ok", batch=bi, step=rung)
+                return out, rung
+            except Exception as e:  # noqa: BLE001 — triage below
+                err = e
+                if classify_backend_error(e) != "oom":
+                    raise
+                log.warning("batch %d still RESOURCE_EXHAUSTED after "
+                            "%s (%s)", bi, rung, self._fault_reason(e))
+        raise err
+
     def _run_batch_resilient(self, bi: int,
                              items: Sequence[tuple]) -> Dict:
-        """Full batch → retry once → bisect to the poison contract(s).
+        """Full batch → degrade (OOM) / retry → bisect to the poison
+        contract(s).
 
         A 10k campaign must lose at most the poison contracts, never the
-        run: any batch failure (timeout, crash, device error) is retried
-        ``max_batch_retries`` times, then the batch is bisected — each
-        half replays through the same compiled shape — until the
-        offending contract(s) are isolated and quarantined with a
-        reason. InjectedKill (and real signals) still blow through
+        run. A failure classified as RESOURCE_EXHAUSTED first walks the
+        degradation ladder (shrink lanes, then batch width, then fall
+        to CPU) — capacity pressure is absorbed by the scheduler, not
+        answered with an abort. Any other failure (timeout, crash,
+        device error) is retried ``max_batch_retries`` times — except a
+        classified compile failure, where replaying the identical shape
+        cannot succeed — then the batch is bisected, each half
+        replaying through the same compiled shape, until the offending
+        contract(s) are isolated and quarantined with a reason.
+        InjectedKill (and real signals) still blow through
         uncheckpointed, which is what the resume path is for."""
         out = {"issues": [], "paths": 0, "dropped": 0, "iprof": {},
                "quarantined": [], "retries": 0, "status": "ok"}
@@ -357,7 +499,23 @@ class CorpusCampaign:
             err = e
             log.warning("batch %d failed (%s)", bi, self._fault_reason(e))
         self._note_failure(err)
-        for _ in range(self.max_batch_retries):
+        kind = classify_backend_error(err)
+        if kind == "oom" and self.oom_ladder:
+            try:
+                degraded, rung = self._degrade_batch(bi, items, err)
+                merge(degraded)
+                out["status"] = f"ok-degraded:{rung}"
+                return out
+            except Exception as e:  # noqa: BLE001 — ladder exhausted
+                err = e
+                self._note_failure(e)
+                log.warning("batch %d degradation exhausted (%s); "
+                            "falling back to retry/bisect", bi,
+                            self._fault_reason(e))
+        # a classified compile failure deterministically reproduces on
+        # an identical replay — skip straight to bisection
+        retry_budget = 0 if kind == "compile" else self.max_batch_retries
+        for _ in range(retry_budget):
             out["retries"] += 1
             try:
                 merge(self._guarded_batch(bi, items))
@@ -420,11 +578,15 @@ class CorpusCampaign:
         stats_at_start = SOLVER_STATS.snapshot()
 
         def session_events() -> List[Dict]:
-            return events_prior + (list(self.backend.events)
-                                   if self.backend is not None else [])
+            return (events_prior
+                    + (list(self.backend.events)
+                       if self.backend is not None else [])
+                    + list(self._events))
 
         n_batches = (len(self.contracts) + self.batch_size - 1) // self.batch_size
-        for bi in range(state["next_batch"], n_batches):
+        dirty = False
+        start_batch = int(state["next_batch"])
+        for bi in range(start_batch, n_batches):
             if deadline is not None and time.monotonic() >= deadline:
                 break
             batch = self.contracts[bi * self.batch_size:(bi + 1) * self.batch_size]
@@ -452,9 +614,22 @@ class CorpusCampaign:
                          backend_events=session_events(),
                          solver={k: round(solver_prior.get(k, 0) + v, 3)
                                  for k, v in sess.items()})
-            self._save_ckpt(state)
+            # --checkpoint-every N: durable write every N batches (and
+            # always after the last); a kill between writes replays at
+            # most N batches whose results were never persisted — no
+            # contract is ever counted twice
+            if (bi + 1 - start_batch) % self.checkpoint_every == 0 \
+                    or bi + 1 == n_batches:
+                self._save_ckpt(state)
+                dirty = False
+            else:
+                dirty = True
             if progress is not None:
                 progress(bi + 1, n_batches, dt, len(res.issues))
+        if dirty:
+            # deadline (or loop-exit) with unpersisted batches: flush so
+            # the paid work survives the session
+            self._save_ckpt(state)
 
         res.batches = len(res.batch_wall)
         res.contracts = min(res.batches * self.batch_size, len(self.contracts))

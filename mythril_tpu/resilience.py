@@ -57,6 +57,12 @@ class DeviceLostError(ResilienceError):
     """The accelerator went away mid-run (injected or detected)."""
 
 
+class ResourceExhausted(ResilienceError):
+    """Device/host memory exhaustion (injected or classified from a
+    backend error). The campaign answers this with the degradation
+    ladder — shrink the work, don't abort the run."""
+
+
 class InjectedKill(BaseException):
     """Simulates SIGKILL mid-batch for kill/resume testing.
 
@@ -106,9 +112,78 @@ def run_with_watchdog(fn: Callable, timeout: Optional[float],
     return box.get("value")
 
 
+# --- backend-error classification -------------------------------------
+
+# message fragments (lowercased) that identify device/host memory
+# exhaustion in XLA/JAX runtime errors across backends: TPU and GPU
+# allocators raise XlaRuntimeError with a RESOURCE_EXHAUSTED status,
+# CPU-side failures surface as MemoryError or "out of memory" strings
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted",
+                "out of memory", "oom ", "allocation failure",
+                "failed to allocate")
+_DEVICE_LOST_MARKERS = ("device_lost", "device lost", "data_loss",
+                        "failed_precondition: device",
+                        "unavailable: device", "device or resource busy",
+                        "device not found")
+_COMPILE_MARKERS = ("compilation failure", "compile failed",
+                    "xla compilation", "error during compilation",
+                    "unimplemented:", "mlir")
+
+
+def classify_backend_error(e: BaseException) -> Optional[str]:
+    """Best-effort triage of a batch failure into the recovery path
+    that can actually cure it: ``"oom"`` (degradation ladder),
+    ``"device-lost"`` (backend re-probe), ``"compile"`` (no point
+    retrying the identical shape — bisect immediately), or ``None``
+    (unclassified: the generic retry → bisect path).
+
+    Matches by type first (:class:`ResourceExhausted`,
+    :class:`DeviceLostError`, ``MemoryError``), then by message
+    fragments of ``XlaRuntimeError``-family exceptions — jaxlib does not
+    export stable subclasses per status code, so the status string in
+    the message is the only portable discriminator."""
+    if isinstance(e, ResourceExhausted) or isinstance(e, MemoryError):
+        return "oom"
+    if isinstance(e, DeviceLostError):
+        return "device-lost"
+    text = f"{type(e).__name__}: {e}".lower()
+    if any(m in text for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in text for m in _DEVICE_LOST_MARKERS):
+        return "device-lost"
+    if any(m in text for m in _COMPILE_MARKERS):
+        return "compile"
+    return None
+
+
+# --- degradation ladder ----------------------------------------------
+
+#: the rungs a campaign batch walks on RESOURCE_EXHAUSTED, in order and
+#: cumulatively: halve the per-contract frontier lanes (displaced forks
+#: park and spill through the engine's defer/rebalance machinery), then
+#: additionally halve the batch width (two half-width sub-batches), then
+#: additionally pin execution to the CPU backend (host RAM >> HBM)
+DEGRADE_RUNGS = ("halve-lanes", "halve-batch", "cpu")
+
+
+def parse_ladder(text: Optional[str]) -> Tuple[str, ...]:
+    """``--oom-ladder`` parser: comma-separated rung names in walk
+    order; ``"none"`` (or empty) disables degradation entirely."""
+    if text is None:
+        return DEGRADE_RUNGS
+    rungs = tuple(r.strip() for r in text.split(",") if r.strip())
+    if rungs in ((), ("none",)):
+        return ()
+    for r in rungs:
+        if r not in DEGRADE_RUNGS:
+            raise ValueError(
+                f"oom ladder rung {r!r}: must be of {DEGRADE_RUNGS}")
+    return rungs
+
+
 # --- fault injection --------------------------------------------------
 
-FAULT_MODES = ("hang", "raise", "device-lost", "kill")
+FAULT_MODES = ("hang", "raise", "device-lost", "kill", "oom")
 
 #: how long an injected hang sleeps per check; the watchdog is expected
 #: to fire long before the total (a daemon thread naps harmlessly after)
@@ -213,6 +288,14 @@ class FaultInjector:
             if spec.mode == "kill":
                 raise InjectedKill(
                     f"injected kill (batch={batch})")
+            if spec.mode == "oom":
+                # message mirrors a real XLA allocator failure so the
+                # classifier exercises the same string path it would on
+                # hardware; ``times=N`` models pressure that clears
+                # after N ladder steps shrink the working set
+                raise ResourceExhausted(
+                    f"injected RESOURCE_EXHAUSTED: out of memory "
+                    f"(batch={batch})")
 
 
 # --- backend management ----------------------------------------------
@@ -314,6 +397,8 @@ class BackendManager:
 
 
 __all__ = [
-    "BackendManager", "BatchTimeout", "DeviceLostError", "FaultInjector",
-    "FaultSpec", "InjectedKill", "ResilienceError", "run_with_watchdog",
+    "BackendManager", "BatchTimeout", "DEGRADE_RUNGS", "DeviceLostError",
+    "FaultInjector", "FaultSpec", "InjectedKill", "ResilienceError",
+    "ResourceExhausted", "classify_backend_error", "parse_ladder",
+    "run_with_watchdog",
 ]

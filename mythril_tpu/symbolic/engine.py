@@ -2601,6 +2601,13 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
                      P).reshape(P)
     req = req_live
 
+    # the iprof residual sidecar is lane-independent: detach it so the
+    # lane-axis gather below never touches it (and cannot mistake the
+    # [256] row for a [P]-shaped leaf when P happens to equal 256)
+    resid = sf.base.op_resid
+    if resid is not None:
+        sf = sf.replace(base=sf.base.replace(op_resid=None))
+
     # scalar run-total counters pass through untouched (ndim == 0); they
     # must not be gathered over the lane axis. The gather itself runs
     # along the intra-block axis only.
@@ -2666,21 +2673,30 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
         # iprof: a fork copy starts with an empty executed-op histogram —
         # its pre-fork instructions were already counted on the source
         # lane. But the RECYCLED slot may hold a retired lane's not-yet-
-        # harvested counts (harvest only runs at tx boundaries): fold
-        # those rows into a surviving lane's row before zeroing — the
-        # harvest sums every row, so totals are conserved.
+        # harvested counts (harvest only runs at tx boundaries): those
+        # rows accumulate into the residual sidecar before the zeroing —
+        # harvest sums every row plus the sidecar, so totals are
+        # conserved while every live lane's row stays its own (ADVICE
+        # r5). Legacy frontiers without the sidecar fold into a live
+        # lane's row as before.
         dead_rows = jnp.sum(
-            jnp.where(is_copy[:, None], sf.base.op_hist, 0), axis=0)
-        tgt = jnp.argmax(b.active & ~is_copy).astype(I32)
-        b = b.replace(
-            op_hist=jnp.where(is_copy[:, None], 0, b.op_hist)
-            .at[tgt].add(dead_rows))
+            jnp.where(is_copy[:, None], sf.base.op_hist, 0), axis=0,
+            dtype=I32)
+        if resid is not None:
+            resid = resid + dead_rows
+            b = b.replace(op_hist=jnp.where(is_copy[:, None], 0, b.op_hist))
+        else:
+            tgt = jnp.argmax(b.active & ~is_copy).astype(I32)
+            b = b.replace(
+                op_hist=jnp.where(is_copy[:, None], 0, b.op_hist)
+                .at[tgt].add(dead_rows))
     new = new.replace(
         base=b.replace(
             pc=pc_new,
             sp=sp_new,
             active=b.active | is_copy,
             stack=stack_c,
+            op_resid=resid,
         ),
         stack_sym=stack_sym_c,
         con_sign=jnp.where(last, True, new.con_sign),
@@ -2741,6 +2757,11 @@ def rebalance_parked(sf: SymFrontier, fork_block: int = 0):
     src = jnp.asarray(src_idx, dtype=I32)
     dst = jnp.asarray(dst_idx, dtype=I32)
 
+    # lane-independent residual sidecar: keep it out of the lane move
+    resid = sf.base.op_resid
+    if resid is not None:
+        sf = sf.replace(base=sf.base.replace(op_resid=None))
+
     def move(x):
         if not hasattr(x, "ndim") or x.ndim == 0:
             return x
@@ -2752,12 +2773,17 @@ def rebalance_parked(sf: SymFrontier, fork_block: int = 0):
         # iprof: the lane's counts moved with it; the vacated slot must
         # not keep a stale copy (the harvest sums every row), and the
         # DESTINATION slots' pre-move rows (a retired lane's unharvested
-        # counts) must not vanish — fold them into the first moved row
-        dead_rows = jnp.sum(sf.base.op_hist[dst], axis=0)
-        b = b.replace(
-            op_hist=b.op_hist.at[src].set(0).at[dst[0]].add(dead_rows))
+        # counts) must not vanish — they land in the residual sidecar
+        # (legacy frontiers without one: fold into the first moved row)
+        dead_rows = jnp.sum(sf.base.op_hist[dst], axis=0, dtype=I32)
+        if resid is not None:
+            resid = resid + dead_rows
+            b = b.replace(op_hist=b.op_hist.at[src].set(0))
+        else:
+            b = b.replace(
+                op_hist=b.op_hist.at[src].set(0).at[dst[0]].add(dead_rows))
     return new.replace(
-        base=b,
+        base=b.replace(op_resid=resid),
         fork_req=new.fork_req.at[src].set(False),
     ), len(src_idx)
 
@@ -2796,6 +2822,13 @@ def migrate_parked_device(sf: SymFrontier, fork_block: int,
         return sf  # single block: nothing to migrate into
     MIG = max(1, min(mig_cap, B // 2))
     NF = G * MIG  # flat buffer size
+
+    # lane-independent residual sidecar: keep it out of the lane-axis
+    # reshape/gather below (reattached, with any newly orphaned rows,
+    # at the end — structure in == structure out, as lax.cond requires)
+    resid = sf.base.op_resid
+    if resid is not None:
+        sf = sf.replace(base=sf.base.replace(op_resid=None))
 
     ab = sf.base.active.reshape(G, B)
     stb = (sf.fork_req & sf.base.active).reshape(G, B)
@@ -2860,18 +2893,26 @@ def migrate_parked_device(sf: SymFrontier, fork_block: int,
     vac = exported.reshape(P)
     b = new.base.replace(active=new.base.active & ~vac)
     if b.op_hist is not None:
-        # migrant rows travelled via mv(); vacated rows zero (they moved);
-        # replaced slots' pre-import rows (retired-lane counts harvest has
-        # not seen) fold into the first imported slot's row — totals are
-        # conserved because harvest sums every row
+        # migrant rows travelled via mv(); vacated rows zero (they
+        # moved); replaced slots' pre-import rows (retired-lane counts
+        # harvest has not seen) accumulate into the residual sidecar —
+        # totals are conserved because harvest sums every row plus the
+        # sidecar, and no live lane's row absorbs another lane's counts
+        # (ADVICE r5). Legacy frontiers without a sidecar keep the old
+        # fold into the first imported slot's row.
         dead_rows = jnp.sum(
             jnp.where(imp_flat[:, None], sf.base.op_hist, 0),
             axis=0).astype(I32)
-        tgt = jnp.argmax(imp_flat).astype(I32)
-        b = b.replace(op_hist=jnp.where(vac[:, None], 0, b.op_hist)
-                      .at[tgt].add(jnp.where(jnp.any(imp_flat),
-                                             dead_rows, 0)))
-    return new.replace(base=b, fork_req=new.fork_req & ~vac)
+        if resid is not None:
+            resid = resid + dead_rows
+            b = b.replace(op_hist=jnp.where(vac[:, None], 0, b.op_hist))
+        else:
+            tgt = jnp.argmax(imp_flat).astype(I32)
+            b = b.replace(op_hist=jnp.where(vac[:, None], 0, b.op_hist)
+                          .at[tgt].add(jnp.where(jnp.any(imp_flat),
+                                                 dead_rows, 0)))
+    return new.replace(base=b.replace(op_resid=resid),
+                       fork_req=new.fork_req & ~vac)
 
 
 @functools.partial(

@@ -7,12 +7,17 @@ import os
 
 
 def atomic_write_json(path: str, obj, indent: int | None = None) -> None:
-    """Write JSON via a pid-suffixed temp file + ``os.replace``: a
-    mid-write kill can never truncate the target, and concurrent
-    writers cannot collide on the temp file (last-replace-wins). Shared
-    by the campaign checkpoint, the profiler's measurement history, and
-    the soak tool."""
+    """Write JSON via a pid-suffixed temp file + flush + fsync +
+    ``os.replace``: a mid-write kill can never truncate the target,
+    concurrent writers cannot collide on the temp file
+    (last-replace-wins), and the payload is on disk before the rename
+    makes it visible — an fsync-less rename can surface as an EMPTY
+    file after a power cut on common filesystems. Shared by the
+    profiler's measurement history and the soak tool; the campaign
+    checkpoint uses the checksummed ``utils.checkpoint`` writers."""
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w") as fh:
         json.dump(obj, fh, indent=indent)
+        fh.flush()
+        os.fsync(fh.fileno())
     os.replace(tmp, path)

@@ -1,14 +1,14 @@
 """Corpus campaign driver (VERDICT r3 ask #6, BASELINE configs 2-3):
 constant-shape batches, one compiled engine, checkpoint/resume."""
 
-import json
-
 import numpy as np
 
 import mythril_tpu  # noqa: F401
 from mythril_tpu.config import TEST_LIMITS
 from mythril_tpu.disassembler.asm import assemble
 from mythril_tpu.mythril.campaign import CorpusCampaign, load_corpus_dir
+from mythril_tpu.utils.checkpoint import (load_json_checkpoint,
+                                          save_json_checkpoint)
 
 KILLABLE = assemble(0, "SELFDESTRUCT")
 SAFE = assemble(1, 0, "SSTORE", "STOP")
@@ -60,13 +60,16 @@ def test_campaign_checkpoint_resume(tmp_path):
     assert again.batches == 2
     assert len(again.issues) == len(full.issues)
 
-    # rewind the cursor to mid-corpus: exactly one batch re-runs
+    # rewind the cursor to mid-corpus: exactly one batch re-runs (the
+    # rewrite goes through the checksummed writer — a hand-edited raw
+    # file would be rejected as corrupt, which is the durability layer
+    # doing its job)
     p = f"{ck}/campaign.json"
-    state = json.load(open(p))
+    state = load_json_checkpoint(p)
     state["next_batch"] = 1
     state["issues"] = [i for i in state["issues"] if i["batch"] < 1]
     state["batch_wall"] = state["batch_wall"][:1]
-    json.dump(state, open(p, "w"))
+    save_json_checkpoint(p, state)
     resumed = make_campaign(corpus, ckpt=ck).run()
     assert resumed.batches == 2
     assert ({i["contract"] for i in resumed.issues}

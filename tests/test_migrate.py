@@ -103,6 +103,37 @@ def test_iprof_rows_conserved_across_migration():
     assert oh[moved[0], 0x57] == 7             # counts travelled with it
 
 
+def test_iprof_residual_sidecar_keeps_rows_attributable():
+    """With the sidecar attached (what ``attach_iprof`` now does), a
+    replaced slot's unharvested counts land in ``op_resid`` instead of
+    being folded into an arbitrary live lane's row (ADVICE r5) — the
+    per-lane histogram stays attributable while harvest totals
+    (rows + sidecar) are conserved."""
+    active = np.zeros(P, dtype=bool)
+    active[0:4] = True
+    parked = np.zeros(P, dtype=bool)
+    parked[2] = True
+    sf = synth(active, parked)
+    # lane 4 = first free slot of the freest block (block 1 — all empty
+    # blocks tie, stable sort picks the lowest) = the import slot the
+    # migrant lands in; its row holds a retired lane's unharvested counts
+    hist = jnp.zeros((P, 256), jnp.int32).at[2, 0x57].set(7).at[4, 0x01].set(3)
+    sf = sf.replace(base=sf.base.replace(
+        op_hist=hist, op_resid=jnp.zeros(256, jnp.int32)))
+
+    out = jax.jit(migrate_parked_device, static_argnums=(1,))(sf, B)
+    oh = np.asarray(out.base.op_hist)
+    resid = np.asarray(out.base.op_resid)
+    moved = np.where(np.asarray(out.base.active)
+                     & (np.asarray(out.base.pc) == 2))[0]
+    assert moved.size == 1
+    assert oh[moved[0], 0x57] == 7     # counts travelled with the lane
+    assert resid[0x01] == 3            # orphaned row -> sidecar, not a lane
+    assert resid.sum() == 3
+    assert oh.sum() == 7               # no live row absorbed foreign counts
+    assert oh.sum() + resid.sum() == 10  # harvest total conserved
+
+
 def test_sharded_migration_matches_unsharded():
     active = np.zeros(P, dtype=bool)
     active[0:4] = True

@@ -24,7 +24,11 @@ from mythril_tpu.mythril.campaign import (CorpusCampaign, load_corpus_dir,
 from mythril_tpu.resilience import (BackendManager, BatchTimeout,
                                     DeviceLostError, FaultInjector,
                                     FaultSpec, InjectedKill,
-                                    ResilienceError, run_with_watchdog)
+                                    ResilienceError, ResourceExhausted,
+                                    classify_backend_error, parse_ladder,
+                                    run_with_watchdog)
+from mythril_tpu.utils.checkpoint import (CheckpointCorrupt,
+                                          load_json_checkpoint)
 
 # --- watchdog ---------------------------------------------------------
 
@@ -86,6 +90,47 @@ def test_fault_injector_from_env(monkeypatch):
     assert len(inj.log) == 2
     monkeypatch.delenv("MYTHRIL_FAULT_INJECT")
     assert FaultInjector.from_env() is None
+
+
+# --- backend-error classification + ladder parsing --------------------
+
+
+def test_classify_backend_error():
+    assert classify_backend_error(ResourceExhausted("boom")) == "oom"
+    assert classify_backend_error(MemoryError()) == "oom"
+    assert classify_backend_error(DeviceLostError("gone")) == "device-lost"
+
+    class XlaRuntimeError(RuntimeError):
+        """jaxlib look-alike: no stable subclasses per status code, so
+        the classifier must go by the status string in the message."""
+
+    assert classify_backend_error(XlaRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 2147483648 bytes"
+    )) == "oom"
+    assert classify_backend_error(XlaRuntimeError(
+        "Execution failed: DEVICE_LOST: device poll timeout")) == "device-lost"
+    assert classify_backend_error(XlaRuntimeError(
+        "XLA compilation failure: invalid HLO")) == "compile"
+    assert classify_backend_error(ValueError("ordinary bug")) is None
+    assert classify_backend_error(RuntimeError("failed to allocate "
+                                               "device buffer")) == "oom"
+
+
+def test_parse_ladder():
+    assert parse_ladder(None) == ("halve-lanes", "halve-batch", "cpu")
+    assert parse_ladder("halve-batch,cpu") == ("halve-batch", "cpu")
+    assert parse_ladder("none") == ()
+    assert parse_ladder("") == ()
+    with pytest.raises(ValueError, match="rung"):
+        parse_ladder("halve-lanes,frobnicate")
+
+
+def test_oom_fault_mode_fires_resource_exhausted():
+    inj = FaultInjector.from_string("oom:batch=1:times=1")
+    with pytest.raises(ResourceExhausted, match="RESOURCE_EXHAUSTED"):
+        inj.fire(batch=1, contracts=[])
+    inj.fire(batch=1, contracts=[])        # times budget spent
+    assert [e["mode"] for e in inj.log] == ["oom"]
 
 
 # --- backend manager --------------------------------------------------
@@ -209,7 +254,7 @@ def test_stub_kill_resume_no_double_count(tmp_path):
     with pytest.raises(InjectedKill):
         stub_campaign(ck, "raise:contract=c002;kill:batch=2").run()
     # the kill struck AFTER batch 1 checkpointed, BEFORE batch 2 did
-    state = json.load(open(os.path.join(ck, "campaign.json")))
+    state = load_json_checkpoint(os.path.join(ck, "campaign.json"))
     assert state["next_batch"] == 2
     assert [q["name"] for q in state["quarantined"]] == ["c002"]
 
@@ -233,15 +278,196 @@ def test_stub_old_checkpoint_schema_resumes(tmp_path):
     with pytest.raises(InjectedKill):
         stub_campaign(ck, "kill:batch=1").run()
     p = os.path.join(ck, "campaign.json")
-    state = json.load(open(p))
+    state = load_json_checkpoint(p)
     for k in ("quarantined", "retries", "batch_status", "backend_events"):
         del state[k]
+    # written back as a BARE state dict — the pre-versioning (v1) JSON
+    # format, so this also covers the old-format load path
     json.dump(state, open(p, "w"))
+    if os.path.exists(p + ".1"):
+        os.unlink(p + ".1")  # v1 runs never rotated
     res = stub_campaign(ck, None).run()
     assert res.batches == 3 and res.retries == 0
     # pre-kill batches carry no status marker in the rewound schema —
     # only the post-resume batches are re-attributed
     assert res.batch_status == ["ok", "ok"]
+
+
+# --- degradation ladder (stub runner) ---------------------------------
+
+
+def _degradable_stub(calls):
+    """Stub runner that understands degraded capacity: records every
+    (batch, n_items, lanes, width) attempt for assertions."""
+
+    def runner(bi, names, codes, lanes=None, width=None):
+        calls.append((bi, len(names), lanes, width))
+        return {"issues": [{"contract": n, "batch": bi}
+                           for n in names if not n.startswith("_pad_")],
+                "paths": len(names), "dropped": 0, "iprof": {}}
+
+    return runner
+
+
+def degradable_campaign(ckpt, fault, calls, **kw):
+    return CorpusCampaign(
+        STUB_CONTRACTS, batch_size=2, checkpoint_dir=ckpt,
+        spec=object(), batch_timeout=5.0,
+        fault_injector=FaultInjector.from_string(fault),
+        batch_runner=_degradable_stub(calls), **kw)
+
+
+def test_oom_degrades_one_rung_and_completes(tmp_path):
+    """Acceptance: a batch that OOMs completes after an automatic lane
+    shrink — visible as backend_events ladder steps — instead of
+    failing/quarantining anything."""
+    calls = []
+    res = degradable_campaign(str(tmp_path / "o1"),
+                              "oom:batch=1:times=1", calls).run()
+    assert res.batches == 3
+    assert res.batch_status == ["ok", "ok-degraded:halve-lanes", "ok"]
+    assert not res.quarantined and res.retries == 0
+    steps = [e["step"] for e in res.backend_events
+             if e["kind"] == "degrade"]
+    assert steps == ["halve-lanes"]
+    assert any(e["kind"] == "degrade_ok" for e in res.backend_events)
+    # every contract analyzed exactly once
+    assert (sorted(i["contract"] for i in res.issues)
+            == [f"c{i:03d}" for i in range(N)])
+    # the degraded attempt really ran with halved frontier lanes
+    degraded = [c for c in calls if c[2] is not None]
+    assert degraded == [(1, 2, 16, 2)]     # default 32 lanes -> 16
+
+
+def test_oom_walks_ladder_cumulatively_to_halve_batch(tmp_path):
+    """Two consecutive OOMs walk to the second rung: lanes stay halved
+    AND the batch replays as two half-width sub-batches."""
+    calls = []
+    res = degradable_campaign(str(tmp_path / "o2"),
+                              "oom:batch=0:times=2", calls).run()
+    assert res.batch_status[0] == "ok-degraded:halve-batch"
+    steps = [e["step"] for e in res.backend_events
+             if e["kind"] == "degrade"]
+    assert steps == ["halve-lanes", "halve-batch"]
+    # the successful rung: two sub-batches of width 1, lanes still 16
+    sub = [c for c in calls if c[3] == 1]
+    assert sub == [(0, 1, 16, 1), (0, 1, 16, 1)]
+    assert (sorted(i["contract"] for i in res.issues)
+            == [f"c{i:03d}" for i in range(N)])
+
+
+def test_oom_cpu_rung_and_event_trail(tmp_path):
+    """Three consecutive OOMs reach the CPU rung (full ladder)."""
+    calls = []
+    res = degradable_campaign(str(tmp_path / "o3"),
+                              "oom:batch=0:times=3", calls).run()
+    # times=3: full attempt, halve-lanes, and halve-batch's FIRST
+    # sub-attempt each fire (a failed rung discards partial results);
+    # the cpu rung's sub-attempts run clean
+    assert res.batch_status[0] == "ok-degraded:cpu"
+    steps = [e["step"] for e in res.backend_events
+             if e["kind"] == "degrade"]
+    assert steps == ["halve-lanes", "halve-batch", "cpu"]
+    assert (sorted(i["contract"] for i in res.issues)
+            == [f"c{i:03d}" for i in range(N)])
+
+
+def test_oom_ladder_exhausted_falls_to_quarantine(tmp_path):
+    """A persistent per-contract OOM (poison, not pressure) exhausts the
+    ladder and lands in the retry→bisect machinery: the run survives,
+    the poison is quarantined with the RESOURCE_EXHAUSTED reason."""
+    res = stub_campaign(str(tmp_path / "oq"), "oom:contract=c002").run()
+    assert res.batches == 3
+    assert [(q["name"], q["batch"]) for q in res.quarantined] == [("c002", 1)]
+    assert "RESOURCE_EXHAUSTED" in res.quarantined[0]["reason"]
+    assert res.batch_status == ["ok", "quarantined:1", "ok"]
+    steps = [e["step"] for e in res.backend_events
+             if e["kind"] == "degrade"]
+    assert steps == ["halve-lanes", "halve-batch", "cpu"]
+    assert ({i["contract"] for i in res.issues}
+            == {"c000", "c001", "c003", "c004", "c005"})
+
+
+def test_oom_ladder_disabled_goes_straight_to_retry(tmp_path):
+    calls = []
+    res = degradable_campaign(str(tmp_path / "o0"),
+                              "oom:batch=1:times=1", calls,
+                              oom_ladder=()).run()
+    # no ladder: the transient OOM is cured by the ordinary retry
+    assert res.batch_status == ["ok", "ok-retry", "ok"]
+    assert res.retries == 1
+    assert not [e for e in res.backend_events if e["kind"] == "degrade"]
+
+
+# --- checkpoint cadence + torn-checkpoint resume ----------------------
+
+
+def test_checkpoint_every_bounds_loss_no_double_count(tmp_path):
+    ck = str(tmp_path / "ce")
+
+    def mk(fault):
+        return CorpusCampaign(
+            STUB_CONTRACTS, batch_size=1, checkpoint_dir=ck,
+            spec=object(), batch_runner=_stub_runner,
+            checkpoint_every=2,
+            fault_injector=FaultInjector.from_string(fault))
+
+    with pytest.raises(InjectedKill):
+        mk("kill:batch=3").run()
+    # batches 0..2 ran; with N=2 cadence only batches 0-1 are durable —
+    # the kill loses at most checkpoint_every batches
+    state = load_json_checkpoint(os.path.join(ck, "campaign.json"))
+    assert state["next_batch"] == 2
+    assert len(state["issues"]) == 2
+    res = mk(None).run()
+    assert res.batches == N
+    # batch 2's unpersisted first-attempt results died with the kill, so
+    # its replay cannot double-count
+    assert (sorted(i["contract"] for i in res.issues)
+            == [f"c{i:03d}" for i in range(N)])
+
+
+def test_torn_campaign_checkpoint_falls_back_to_rotation(tmp_path):
+    """Acceptance: SIGKILL mid-checkpoint-write (simulated by truncating
+    the newest checkpoint at several offsets) resumes from the rotated
+    last-known-good copy, losing at most one batch, analyzing nothing
+    twice."""
+    ck = str(tmp_path / "torn")
+    with pytest.raises(InjectedKill):
+        stub_campaign(ck, "kill:batch=2").run()   # batches 0,1 durable
+    p = os.path.join(ck, "campaign.json")
+    raw = open(p, "rb").read()
+    for cut in (0, 7, len(raw) // 2, len(raw) - 2):
+        with open(p, "wb") as fh:
+            fh.write(raw[:cut])
+        res = stub_campaign(ck, None).run()
+        assert "checkpoint_recovered" in [e["kind"]
+                                          for e in res.backend_events]
+        # rotated copy says next_batch=1: batch 1 replays (its results
+        # were only in the discarded torn file), batch 2 runs — every
+        # contract exactly once
+        assert res.batches == 3
+        assert (sorted(i["contract"] for i in res.issues)
+                == [f"c{i:03d}" for i in range(N)])
+        # restore the torn newest for the next tear shape
+        with open(p, "wb") as fh:
+            fh.write(raw)
+
+
+def test_first_checkpoint_torn_starts_fresh(tmp_path):
+    ck = str(tmp_path / "fresh")
+    with pytest.raises(InjectedKill):
+        stub_campaign(ck, "kill:batch=1").run()   # only batch 0 durable
+    p = os.path.join(ck, "campaign.json")
+    if os.path.exists(p + ".1"):
+        os.unlink(p + ".1")
+    with open(p, "w") as fh:
+        fh.write('{"__schema__": 2, "sha256": "tor')
+    res = stub_campaign(ck, None).run()
+    assert res.batches == 3
+    assert (sorted(i["contract"] for i in res.issues)
+            == [f"c{i:03d}" for i in range(N)])
+    assert "checkpoint_reset" in [e["kind"] for e in res.backend_events]
 
 
 def test_merge_campaigns_carries_resilience_fields():
@@ -295,7 +521,7 @@ def test_engine_fault_quarantine_kill_and_resume(tmp_path):
     with pytest.raises(InjectedKill):
         engine_campaign(corpus, ckpt=ck,
                         fault="raise:contract=c002;kill:batch=1").run()
-    state = json.load(open(os.path.join(ck, "campaign.json")))
+    state = load_json_checkpoint(os.path.join(ck, "campaign.json"))
     assert state["next_batch"] == 1
     assert [q["name"] for q in state["quarantined"]] == ["c002"]
 
@@ -318,6 +544,35 @@ def test_engine_fault_quarantine_kill_and_resume(tmp_path):
     assert (sorted(i["contract"] for i in straight.issues) == found)
     assert ([(q["name"], q["batch"]) for q in straight.quarantined]
             == [(q["name"], q["batch"]) for q in resumed.quarantined])
+
+
+def test_cli_campaign_oom_degrade_end_to_end(tmp_path, capsys):
+    """Acceptance via the CLI with the REAL engine: a batch that OOMs
+    (injected) completes after the automatic lane shrink — the ladder
+    step is visible in backend_events, nothing is quarantined, and the
+    issue set matches an unfaulted run."""
+    from mythril_tpu.interfaces.cli import main
+
+    corpus = write_corpus(tmp_path)
+    rc = main(["analyze", "--corpus", corpus, "--batch-size", "4",
+               "--lanes-per-contract", "8", "--max-steps", "64",
+               "--limits-profile", "test", "-t", "1",
+               "-m", "AccidentallyKillable", "-o", "json",
+               "--fault-inject", "oom:batch=0:times=1",
+               "--oom-ladder", "halve-lanes",
+               "--checkpoint-every", "2",
+               "--checkpoint-dir", str(tmp_path / "ck")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["batch_status"][0] == "ok-degraded:halve-lanes"
+    steps = [e.get("step") for e in payload["backend_events"]
+             if e["kind"] == "degrade"]
+    assert steps == ["halve-lanes"]
+    assert not payload["quarantined"]
+    # the degraded (4-lane) replay still finds every killable contract
+    assert ({i["contract"] for i in payload["issues_detail"]}
+            == {"c000", "c002", "c004"})
 
 
 def test_cli_campaign_fault_flags(tmp_path, capsys):

@@ -2,7 +2,7 @@
 """Resilience soak smoke: a small corpus with injected faults, end to
 end on the CPU backend.
 
-Three legs, one process (see docs/resilience.md):
+Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
 
   1. transient — a raise fault at batch 0 with ``times=1``; the
      retry-once policy must cure it with nothing quarantined;
@@ -11,16 +11,35 @@ Three legs, one process (see docs/resilience.md):
      other batch;
   3. kill+resume — a simulated SIGKILL (InjectedKill) mid-campaign on
      top of the poison; the resumed session must converge to the same
-     final issue set and quarantine list as leg 2.
+     final issue set and quarantine list as leg 2;
+  4. oom — an injected RESOURCE_EXHAUSTED at batch 0; the degradation
+     ladder must shrink the batch (visible as ``degrade`` backend
+     events) and the campaign must still find every issue with nothing
+     quarantined (``--fault-inject`` overrides the injected spec);
+  5. torn-checkpoint — kill mid-campaign, then truncate the newest
+     checkpoint mid-file (a kill -9 DURING the checkpoint write); the
+     resume must fall back to the rotated last-known-good copy and
+     converge to leg 2's final state with nothing double-counted.
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
 
     JAX_PLATFORMS=cpu python tools/soak_campaign.py
+    JAX_PLATFORMS=cpu python tools/soak_campaign.py --legs oom,torn
+    JAX_PLATFORMS=cpu python tools/soak_campaign.py \
+        --fault-inject oom:batch=0:times=2
+
+Env gates (PROF_INIT_TIMEOUT-style, all opt-in):
+
+  SOAK_INIT_TIMEOUT=<sec>   probe backend init in a subprocess first,
+                            falling back to CPU on failure (same gate
+                            tools/profile_superstep.py exposes)
+  SOAK_BATCH_TIMEOUT=<sec>  per-batch watchdog budget (default 300)
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -32,6 +51,18 @@ sys.path.insert(0, ROOT)
 # the soak is a CPU functional check; never let it touch (and possibly
 # wedge on) a configured accelerator backend
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_INIT_TIMEOUT = float(os.environ.get("SOAK_INIT_TIMEOUT", "0") or 0)
+_BATCH_TIMEOUT = float(os.environ.get("SOAK_BATCH_TIMEOUT", "300") or 300)
+
+if _INIT_TIMEOUT > 0:
+    # gate BEFORE the engine import, like the campaign CLI does
+    from mythril_tpu.resilience import BackendManager
+
+    _ok, _diag = BackendManager(init_timeout=_INIT_TIMEOUT).ensure_or_fallback()
+    if not _ok:
+        print(f"soak: backend unavailable ({_diag}); continuing on CPU",
+              file=sys.stderr)
 
 import mythril_tpu  # noqa: E402,F401  (enables x64)
 from mythril_tpu.config import TEST_LIMITS  # noqa: E402
@@ -45,6 +76,8 @@ KILLABLE = assemble(0, "SELFDESTRUCT")
 SAFE = assemble(1, 0, "SSTORE", "STOP")
 N = 6  # even indices killable -> expected issues c000/c002/c004
 
+LEGS = ("transient", "poison", "kill_resume", "oom", "torn")
+
 
 def write_corpus(d: str) -> str:
     corpus = os.path.join(d, "corpus")
@@ -56,57 +89,120 @@ def write_corpus(d: str) -> str:
     return corpus
 
 
-def campaign(corpus: str, ckpt: str, fault: str | None):
+def campaign(corpus: str, ckpt: str, fault: str | None, **kw):
     return CorpusCampaign(
         load_corpus_dir(corpus),
         batch_size=4, lanes_per_contract=8, limits=TEST_LIMITS,
         max_steps=64, transaction_count=1,
         modules=["AccidentallyKillable"], checkpoint_dir=ckpt,
-        batch_timeout=300.0,  # generous: guards the soak, not the test
+        batch_timeout=_BATCH_TIMEOUT,  # guards the soak, not the test
         fault_injector=FaultInjector.from_string(fault),
-    )
+        **kw)
 
 
 def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--legs", default=",".join(LEGS),
+                    help=f"comma-separated subset of {LEGS}")
+    ap.add_argument("--fault-inject", default="oom:batch=0:times=1",
+                    metavar="SPEC",
+                    help="fault spec for the oom leg (e.g. "
+                         "'oom:batch=0:times=2' to walk two rungs)")
+    args = ap.parse_args()
+    want = {leg.strip() for leg in args.legs.split(",") if leg.strip()}
+    bad = want - set(LEGS)
+    if bad:
+        ap.error(f"unknown legs {sorted(bad)}; choose from {LEGS}")
+
     legs: dict = {}
     ok = True
     with tempfile.TemporaryDirectory() as d:
         corpus = write_corpus(d)
 
-        # leg 1: transient fault cured by the retry-once policy
-        r = campaign(corpus, os.path.join(d, "ck1"),
-                     "raise:batch=0:times=1").run()
-        legs["transient"] = {"retries": r.retries,
-                             "quarantined": len(r.quarantined),
-                             "issues": len(r.issues)}
-        ok &= (r.retries == 1 and not r.quarantined
-               and len(r.issues) == 3)
+        if "transient" in want:
+            # leg 1: transient fault cured by the retry-once policy
+            r = campaign(corpus, os.path.join(d, "ck1"),
+                         "raise:batch=0:times=1").run()
+            legs["transient"] = {"retries": r.retries,
+                                 "quarantined": len(r.quarantined),
+                                 "issues": len(r.issues)}
+            ok &= (r.retries == 1 and not r.quarantined
+                   and len(r.issues) == 3)
 
-        # leg 2: persistent poison -> bisect -> quarantine, run survives
-        r2 = campaign(corpus, os.path.join(d, "ck2"),
-                      "raise:contract=c002").run()
-        legs["poison"] = {"quarantined": [q["name"] for q in r2.quarantined],
-                          "batch_status": r2.batch_status,
-                          "issues": sorted(i["contract"] for i in r2.issues)}
-        ok &= ([q["name"] for q in r2.quarantined] == ["c002"]
-               and legs["poison"]["issues"] == ["c000", "c004"])
+        expected_issues = ["c000", "c004"]  # c002 lost to the poison
+        if "poison" in want or "torn" in want:
+            # leg 2: persistent poison -> bisect -> quarantine, run
+            # survives (also the reference state for the torn leg)
+            r2 = campaign(corpus, os.path.join(d, "ck2"),
+                          "raise:contract=c002").run()
+            legs["poison"] = {
+                "quarantined": [q["name"] for q in r2.quarantined],
+                "batch_status": r2.batch_status,
+                "issues": sorted(i["contract"] for i in r2.issues)}
+            ok &= ([q["name"] for q in r2.quarantined] == ["c002"]
+                   and legs["poison"]["issues"] == expected_issues)
 
-        # leg 3: kill mid-campaign, then resume to the same final state
-        ck3 = os.path.join(d, "ck3")
-        killed = False
-        try:
-            campaign(corpus, ck3, "raise:contract=c002;kill:batch=1").run()
-        except InjectedKill:
-            killed = True
-        r3 = campaign(corpus, ck3, "raise:contract=c002").run()
-        legs["kill_resume"] = {
-            "killed": killed,
-            "batches": r3.batches,
-            "quarantined": [q["name"] for q in r3.quarantined],
-            "issues": sorted(i["contract"] for i in r3.issues)}
-        ok &= (killed and r3.batches == 2
-               and legs["kill_resume"]["quarantined"] == ["c002"]
-               and legs["kill_resume"]["issues"] == legs["poison"]["issues"])
+        if "kill_resume" in want:
+            # leg 3: kill mid-campaign, then resume to the same final state
+            ck3 = os.path.join(d, "ck3")
+            killed = False
+            try:
+                campaign(corpus, ck3,
+                         "raise:contract=c002;kill:batch=1").run()
+            except InjectedKill:
+                killed = True
+            r3 = campaign(corpus, ck3, "raise:contract=c002").run()
+            legs["kill_resume"] = {
+                "killed": killed,
+                "batches": r3.batches,
+                "quarantined": [q["name"] for q in r3.quarantined],
+                "issues": sorted(i["contract"] for i in r3.issues)}
+            ok &= (killed and r3.batches == 2
+                   and legs["kill_resume"]["quarantined"] == ["c002"]
+                   and legs["kill_resume"]["issues"] == expected_issues)
+
+        if "oom" in want:
+            # leg 4: RESOURCE_EXHAUSTED absorbed by the degradation
+            # ladder — batch completes smaller instead of failing
+            r4 = campaign(corpus, os.path.join(d, "ck4"),
+                          args.fault_inject).run()
+            steps = [e.get("step") for e in r4.backend_events
+                     if e.get("kind") == "degrade"]
+            legs["oom"] = {
+                "degrade_steps": steps,
+                "batch_status": r4.batch_status,
+                "quarantined": len(r4.quarantined),
+                "issues": sorted(i["contract"] for i in r4.issues)}
+            ok &= (bool(steps) and not r4.quarantined
+                   and legs["oom"]["issues"] == ["c000", "c002", "c004"]
+                   and any(s.startswith("ok-degraded:")
+                           for s in r4.batch_status))
+
+        if "torn" in want:
+            # leg 5: kill -9 DURING a checkpoint write — run the poison
+            # campaign to completion, then truncate its NEWEST
+            # checkpoint mid-file (exactly what a kill mid-write leaves
+            # behind); the resume must detect the tear via checksum,
+            # fall back to the rotated last-known-good copy, replay only
+            # the batch the torn file described, and converge to leg 2's
+            # final state with nothing double-counted
+            ck5 = os.path.join(d, "ck5")
+            campaign(corpus, ck5, "raise:contract=c002").run()
+            p = os.path.join(ck5, "campaign.json")
+            raw = open(p, "rb").read()
+            with open(p, "wb") as fh:
+                fh.write(raw[:len(raw) // 2])   # torn mid-write
+            r5 = campaign(corpus, ck5, "raise:contract=c002").run()
+            kinds = [e.get("kind") for e in r5.backend_events]
+            legs["torn"] = {
+                "recovered": "checkpoint_recovered" in kinds,
+                "batches": r5.batches,
+                "quarantined": [q["name"] for q in r5.quarantined],
+                "issues": sorted(i["contract"] for i in r5.issues)}
+            ok &= (legs["torn"]["recovered"]
+                   and r5.batches == 2
+                   and legs["torn"]["quarantined"] == ["c002"]
+                   and legs["torn"]["issues"] == legs["poison"]["issues"])
 
     print(json.dumps({"ok": bool(ok), "legs": legs}))
     return 0 if ok else 1
