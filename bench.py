@@ -24,7 +24,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
@@ -34,6 +33,16 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tes
 # runtime that hangs before the probe can run (this is exactly how the
 # round-3 driver bench died). Everything heavy loads in _lazy_imports()
 # AFTER _probe_backend() has proven the backend comes up.
+#
+# obs.trace is the one exception: stdlib-only (no jnp tables — the same
+# backend-free guarantee resilience.py gives the pre-probe phase, which
+# already imports the mythril_tpu package). All phase timing below rides
+# its timer spans instead of ad-hoc perf_counter/monotonic pairs; set
+# BENCH_TRACE=FILE to get a Perfetto-loadable trace of a bench run.
+from mythril_tpu.obs import trace as obs_trace
+
+if os.environ.get("BENCH_TRACE"):
+    obs_trace.configure(os.environ["BENCH_TRACE"])
 
 
 def _lazy_imports():
@@ -82,12 +91,13 @@ def count_ref_steps(code: bytes) -> int:
 
 def bench_cpu_baseline(code: bytes, min_seconds: float = 1.0) -> float:
     """Pure-Python interpreter lane-steps/sec (one core)."""
-    n, steps, t0 = 0, 0, time.perf_counter()
-    while time.perf_counter() - t0 < min_seconds:
-        vm = RefEVM(code, calldata=abi_call(TRANSFER_SELECTOR, 0x1000 + n, 0), env=RefEnv(caller=CALLER))
-        steps += vm.run(max_steps=MAX_STEPS).steps
-        n += 1
-    return steps / (time.perf_counter() - t0)
+    with obs_trace.timer("bench.cpu_baseline") as sp:
+        n, steps = 0, 0
+        while sp.elapsed < min_seconds:
+            vm = RefEVM(code, calldata=abi_call(TRANSFER_SELECTOR, 0x1000 + n, 0), env=RefEnv(caller=CALLER))
+            steps += vm.run(max_steps=MAX_STEPS).steps
+            n += 1
+        return steps / sp.elapsed
 
 
 def bench_concrete():
@@ -101,11 +111,11 @@ def bench_concrete():
         return None, None, "concrete lanes failed"
 
     reps = 5
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = runner(f)
-    jax.block_until_ready(out.pc)
-    dt = (time.perf_counter() - t0) / reps
+    with obs_trace.timer("bench.concrete", reps=reps, P=P) as sp:
+        for _ in range(reps):
+            out = runner(f)
+        jax.block_until_ready(out.pc)
+    dt = sp.elapsed / reps
 
     device_steps_per_sec = P * ref_steps / dt
     cpu_steps_per_sec = bench_cpu_baseline(code)
@@ -136,11 +146,11 @@ def bench_symbolic() -> dict:
     steps_total = int(np.asarray(out.base.n_steps).sum())
 
     reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = runner(sf)
-    jax.block_until_ready(out.base.pc)
-    dt = (time.perf_counter() - t0) / reps
+    with obs_trace.timer("bench.symbolic", reps=reps, P=SYM_P) as sp:
+        for _ in range(reps):
+            out = runner(sf)
+        jax.block_until_ready(out.base.pc)
+    dt = sp.elapsed / reps
     return {
         "sym_lane_steps_per_sec": round(steps_total / dt, 1),
         "sym_paths": int((np.asarray(out.base.active)
@@ -169,9 +179,10 @@ def bench_analyze() -> dict:
 
     once()  # compile warm-up
     SOLVER_STATS.reset()
-    t0 = time.perf_counter()
-    sym, report = once()
-    dt = time.perf_counter() - t0
+    with obs_trace.timer("bench.analyze",
+                         contracts=ANALYZE_CONTRACTS) as sp:
+        sym, report = once()
+    dt = sp.elapsed
     cov = sym.coverage
     steps_total = int(np.asarray(sym.sf.base.n_steps).sum())
     return {
@@ -348,12 +359,14 @@ def main():
     # total wall-clock budget (round-3 lesson: the driver kills the whole
     # process at ~590 s — a partial JSON line beats a SIGKILL'd full one).
     # Each extra section only starts if its cost estimate still fits.
+    # The budget clock is a stopwatch span: its live `elapsed` gates the
+    # sections, and a BENCH_TRACE run records the driver as one span.
     budget = float(os.environ.get("MYTHRIL_BENCH_BUDGET", "520"))
     _arm_watchdog(budget)
-    t_start = time.monotonic()
+    sw = obs_trace.timer("bench.main", budget=budget).start()
 
     def remaining() -> float:
-        return budget - (time.monotonic() - t_start)
+        return budget - sw.elapsed
 
     if not os.environ.get("MYTHRIL_BENCH_NO_PROBE"):
         ok, diag = _probe_backend()
@@ -399,6 +412,7 @@ def main():
                 extra["profile_error"] = repr(e)[:200]
         else:
             extra["profile_skipped"] = "budget: %.0fs left" % remaining()
+    sw.stop()
     _emit(value, vs, note, extra)
 
 
@@ -408,3 +422,5 @@ if __name__ == "__main__":
     except BaseException as e:  # the one-JSON-line contract is absolute
         _emit(0.0, 0.0, "unhandled", {}, error="unhandled: %r" % (e,))
         raise SystemExit(0)
+    finally:
+        obs_trace.close()  # writes the BENCH_TRACE Chrome file, if any

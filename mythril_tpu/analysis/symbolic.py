@@ -20,6 +20,8 @@ from ..config import DEFAULT_LIMITS, LimitsConfig
 from ..core import Corpus, make_env
 from ..core.frontier import ATTACKER_ADDRESS, CAP_TRAPS, TRAP_NAMES
 from ..disassembler import ContractImage
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..smt.eval import Assignment
 from ..smt.solver import solve_tape
 from ..smt.tape import (HostNode, HostTape, TapeHostCache, extract_tape,
@@ -382,10 +384,15 @@ class SymExecWrapper:
 
             if (self._deadline_at is None and self.checkpoint_dir is None
                     and not self.spill):
-                sf, vis = sym_run(sf, env, self.corpus, spec, limits,
-                                  max_steps=max_steps, track_coverage=True,
-                                  fork_policy=self.fork_policy,
-                                  fork_block=self.fork_block)
+                # execute + fork fuse inside the jitted superstep loop;
+                # the host-visible unit (and the span) is the whole call
+                with obs_trace.span("superstep", tx=self._cur_tx,
+                                    steps=max_steps):
+                    sf, vis = sym_run(sf, env, self.corpus, spec, limits,
+                                      max_steps=max_steps,
+                                      track_coverage=True,
+                                      fork_policy=self.fork_policy,
+                                      fork_block=self.fork_block)
                 self._visited |= np.asarray(vis)
                 return sf
             steps_done = 0
@@ -409,25 +416,36 @@ class SymExecWrapper:
                     remaining = self._deadline_at - _time.monotonic()
                     if remaining < sec_per_step * n:
                         n = q
-                t0 = _time.monotonic()
-                sf, vis = sym_run(
-                    sf, env, self.corpus, spec, limits,
-                    max_steps=n,
-                    track_coverage=True, fork_policy=self.fork_policy,
-                    fork_block=self.fork_block,
-                    defer_starved=self.spill,
-                    migrate_every=self.migrate_every)
+                cold = n not in warm_shapes
+                with obs_trace.timer("superstep", tx=self._cur_tx,
+                                     steps=n, done=steps_done,
+                                     cold=cold) as sp:
+                    sf, vis = sym_run(
+                        sf, env, self.corpus, spec, limits,
+                        max_steps=n,
+                        track_coverage=True, fork_policy=self.fork_policy,
+                        fork_block=self.fork_block,
+                        defer_starved=self.spill,
+                        migrate_every=self.migrate_every)
                 self._visited |= np.asarray(vis)
                 # a shape's first run pays XLA compilation — not a sample
-                if n in warm_shapes:
-                    sec_per_step = max(sec_per_step,
-                                       (_time.monotonic() - t0) / n)
-                else:
+                if cold:
                     warm_shapes.add(n)
+                    obs_metrics.REGISTRY.counter(
+                        "engine_compiles_total",
+                        help="distinct chunk shapes compiled").inc()
+                else:
+                    sec_per_step = max(sec_per_step, sp.elapsed / n)
+                obs_metrics.REGISTRY.counter("engine_supersteps_total").inc(n)
                 steps_done += n
                 if self.spill:
-                    sf, moved = rebalance_parked(sf, self.fork_block)
+                    with obs_trace.span("rebalance", tx=self._cur_tx):
+                        sf, moved = rebalance_parked(sf, self.fork_block)
                     self._rebalanced += moved
+                    obs_metrics.REGISTRY.counter(
+                        "rebalanced_lanes_total",
+                        help="parked lanes re-seeded at host seams").inc(moved)
+                self._observe_frontier(sf)
                 self.plugin_loader.fire("on_chunk", sf, steps_done)
                 if self.checkpoint_dir is not None:
                     self._save_checkpoint(sf, steps_done)
@@ -443,24 +461,32 @@ class SymExecWrapper:
                 # admitted late through no fault of their path, so they
                 # get bounded extra chunks (reference analog: the work
                 # list drains until empty or timeout)
-                for _ in range(4):
-                    parked = (np.asarray(sf.fork_req)
-                              & np.asarray(sf.base.active))
-                    if not parked.any():
-                        break
-                    if self.timed_out or (
-                            self._deadline_at is not None
-                            and _time.monotonic() >= self._deadline_at):
-                        break  # the drain respects the wall clock too
-                    sf, moved = rebalance_parked(sf, self.fork_block)
-                    self._rebalanced += moved
-                    sf, vis = sym_run(
-                        sf, env, self.corpus, spec, limits,
-                        max_steps=self._chunk,
-                        track_coverage=True, fork_policy=self.fork_policy,
-                        fork_block=self.fork_block, defer_starved=True,
-                        migrate_every=self.migrate_every)
-                    self._visited |= np.asarray(vis)
+                with obs_trace.span("drain", tx=self._cur_tx):
+                    for _ in range(4):
+                        parked = (np.asarray(sf.fork_req)
+                                  & np.asarray(sf.base.active))
+                        if not parked.any():
+                            break
+                        if self.timed_out or (
+                                self._deadline_at is not None
+                                and _time.monotonic() >= self._deadline_at):
+                            break  # the drain respects the wall clock too
+                        with obs_trace.span("rebalance", tx=self._cur_tx):
+                            sf, moved = rebalance_parked(sf, self.fork_block)
+                        self._rebalanced += moved
+                        obs_metrics.REGISTRY.counter(
+                            "rebalanced_lanes_total").inc(moved)
+                        with obs_trace.span("superstep", tx=self._cur_tx,
+                                            steps=self._chunk, drain=True):
+                            sf, vis = sym_run(
+                                sf, env, self.corpus, spec, limits,
+                                max_steps=self._chunk,
+                                track_coverage=True,
+                                fork_policy=self.fork_policy,
+                                fork_block=self.fork_block,
+                                defer_starved=True,
+                                migrate_every=self.migrate_every)
+                        self._visited |= np.asarray(vis)
                 # forks still parked after draining are lost coverage —
                 # count them in the drop channel for honesty
                 self._parked_end += int(
@@ -470,29 +496,33 @@ class SymExecWrapper:
         def run_one_tx(sf, is_last: bool, handoff_kw=None):
             self.plugin_loader.fire("on_tx_start", self._cur_tx, sf)
             sf = explore(sf)
-            # err_code is zeroed by between_txs, so every nonzero code here
-            # is a loss from THIS transaction
-            trap_counts = _count_traps(np.asarray(sf.base.err_code))
-            ctx = AnalysisContext(
-                sf=sf, corpus=self.corpus, limits=limits,
-                contract_names=names, solver_iters=solver_iters,
-                solver_timeout=solver_timeout,
-                trap_counts=trap_counts, timed_out=self.timed_out,
-            )
-            self.tx_contexts.append(ctx)
-            if self.enable_iprof:
-                import jax.numpy as jnp
-                self._iprof += np.asarray(sf.base.op_hist).sum(
-                    axis=0, dtype=np.int64)
-                repl = {"op_hist": jnp.zeros_like(sf.base.op_hist)}
-                if sf.base.op_resid is not None:
-                    # residual sidecar: retired lanes' counts orphaned
-                    # by slot recycling / lane movement since the last
-                    # harvest (per-lane rows stay attributable)
-                    self._iprof += np.asarray(
-                        sf.base.op_resid).astype(np.int64)
-                    repl["op_resid"] = jnp.zeros_like(sf.base.op_resid)
-                sf = sf.replace(base=sf.base.replace(**repl))
+            # harvest: pull per-tx results (traps, iprof rows) off the
+            # device and snapshot the context modules will consume
+            with obs_trace.span("harvest", tx=self._cur_tx):
+                # err_code is zeroed by between_txs, so every nonzero
+                # code here is a loss from THIS transaction
+                trap_counts = _count_traps(np.asarray(sf.base.err_code))
+                ctx = AnalysisContext(
+                    sf=sf, corpus=self.corpus, limits=limits,
+                    contract_names=names, solver_iters=solver_iters,
+                    solver_timeout=solver_timeout,
+                    trap_counts=trap_counts, timed_out=self.timed_out,
+                )
+                self.tx_contexts.append(ctx)
+                if self.enable_iprof:
+                    import jax.numpy as jnp
+                    self._iprof += np.asarray(sf.base.op_hist).sum(
+                        axis=0, dtype=np.int64)
+                    repl = {"op_hist": jnp.zeros_like(sf.base.op_hist)}
+                    if sf.base.op_resid is not None:
+                        # residual sidecar: retired lanes' counts
+                        # orphaned by slot recycling / lane movement
+                        # since the last harvest (per-lane rows stay
+                        # attributable)
+                        self._iprof += np.asarray(
+                            sf.base.op_resid).astype(np.int64)
+                        repl["op_resid"] = jnp.zeros_like(sf.base.op_resid)
+                    sf = sf.replace(base=sf.base.replace(**repl))
             self.plugin_loader.fire("on_tx_end", ctx)
             if not is_last:
                 if self.dyn_loader is not None:
@@ -673,6 +703,23 @@ class SymExecWrapper:
                  {"address": f"0x{a:040x}", "sha256": h}
                  for a, h in zip(self.dynld_loaded, self._dynld_sha)]},
         )
+
+    def _observe_frontier(self, sf) -> None:
+        """Frontier occupancy / park gauges after a chunk. The reads are
+        host transfers (device sync), so they run only when telemetry is
+        actually on — a bare run must not pay them."""
+        reg = obs_metrics.REGISTRY
+        if not (reg.enabled or obs_trace.active()):
+            return
+        act = np.asarray(sf.base.active)
+        parked = int((np.asarray(sf.fork_req) & act).sum())
+        reg.gauge("frontier_active_lanes",
+                  help="live lanes after the last chunk").set(float(act.sum()))
+        reg.gauge("frontier_occupancy",
+                  help="live-lane fraction of the frontier").set(
+            float(act.mean()) if act.size else 0.0)
+        reg.gauge("frontier_parked_lanes",
+                  help="lanes parked on a starved fork").set(float(parked))
 
     def instruction_coverage(self) -> Dict[str, float]:
         """Per-contract % of real instructions reached (reference:

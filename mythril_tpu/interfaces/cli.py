@@ -160,6 +160,25 @@ def create_parser() -> argparse.ArgumentParser:
                         "N batches (default 1 — kill -9 at any instant "
                         "loses at most one batch; larger N trades "
                         "replayed batches for less checkpoint I/O)")
+    a.add_argument("--trace", metavar="FILE",
+                   help="write a Chrome-trace JSON to FILE (load it in "
+                        "Perfetto / chrome://tracing) plus an append-"
+                        "only JSONL event log beside it (FILE with a "
+                        ".jsonl suffix); spans cover supersteps, "
+                        "batches, checkpoints, degrades — see "
+                        "docs/observability.md and tools/trace_report.py")
+    a.add_argument("--metrics", metavar="FILE",
+                   help="write a metrics snapshot at exit: counters/"
+                        "gauges/histograms (frontier occupancy, "
+                        "fork/park/spill rates, solver checks, degrade "
+                        "and compile events, checkpoint latency) as "
+                        "JSON, or Prometheus text format when FILE "
+                        "ends in .prom/.txt")
+    a.add_argument("--heartbeat", type=float, default=None, metavar="SEC",
+                   help="campaign mode: print a one-line progress "
+                        "heartbeat to stderr at most every SEC seconds "
+                        "(contracts done, paths/s, frontier occupancy, "
+                        "degrade rung, last-checkpoint age)")
     a.add_argument("--num-hosts", type=int, default=0, metavar="N",
                    help="campaign mode: shard the corpus across N hosts; "
                         "this process analyzes slice --host-index "
@@ -350,6 +369,28 @@ def exec_analyze(args) -> int:
         print("error: --concrete-storage conflicts with "
               "--unconstrained-storage", file=sys.stderr)
         raise SystemExit(2)
+    # telemetry spine (docs/observability.md): configure the process
+    # tracer / metrics registry BEFORE the engine loads, finalize on
+    # every exit path — a crashed run still leaves the JSONL prefix and
+    # a best-effort metrics snapshot behind. obs imports are stdlib-only
+    # so this stays safe pre-backend-probe.
+    from ..obs import metrics as obs_metrics
+    from ..obs import trace as obs_trace
+
+    if getattr(args, "trace", None):
+        obs_trace.configure(args.trace)
+    if getattr(args, "metrics", None):
+        obs_metrics.REGISTRY.enabled = True
+    try:
+        return _exec_analyze_inner(args)
+    finally:
+        if getattr(args, "trace", None):
+            obs_trace.close()
+        if getattr(args, "metrics", None):
+            obs_metrics.REGISTRY.write(args.metrics)
+
+
+def _exec_analyze_inner(args) -> int:
     # campaign mode dispatches BEFORE any engine import: --init-timeout
     # must be able to probe (and fall back from) a wedged backend while
     # this process is still backend-free
@@ -520,6 +561,7 @@ def _exec_campaign(args) -> int:
         backend=backend,
         oom_ladder=oom_ladder,
         checkpoint_every=args.checkpoint_every,
+        heartbeat_every=args.heartbeat,
     )
 
     def progress(done, total, dt, n_issues):

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
@@ -39,6 +40,8 @@ if TYPE_CHECKING:  # import is heavy at runtime (engine); lazy below
     from ..symbolic import SymSpec
 
 from ..config import DEFAULT_LIMITS, DEFAULT_RESILIENCE, LimitsConfig
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..resilience import (BackendManager, BatchTimeout, DeviceLostError,
                           FaultInjector, classify_backend_error,
                           run_with_watchdog)
@@ -168,6 +171,7 @@ class CorpusCampaign:
         batch_runner=None,
         oom_ladder: Optional[Sequence[str]] = None,
         checkpoint_every: int = DEFAULT_RESILIENCE.checkpoint_every,
+        heartbeat_every: Optional[float] = None,
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -238,8 +242,21 @@ class CorpusCampaign:
                                 if oom_ladder is None else oom_ladder)
         self.checkpoint_every = max(1, int(checkpoint_every))
         # campaign-level structured events (degradation steps, checkpoint
-        # recoveries) — merged with the BackendManager's into the report
+        # recoveries) — merged with the BackendManager's into the report.
+        # Every event carries BOTH clocks plus a session token: wall time
+        # (`t`) is comparable across resumed sessions but can step;
+        # monotonic (`mono`) orders within a session; `session` lets
+        # merge_campaigns keep per-session streams contiguous.
         self._events: List[Dict] = []
+        self._session = f"{os.getpid():x}-{int(time.time() * 1000):x}"
+        # telemetry spine (docs/observability.md): events are re-emitted
+        # onto the obs.trace bus (when one is configured), batches get
+        # spans, and --heartbeat N prints a one-line progress pulse at
+        # most every N seconds
+        self.heartbeat_every = heartbeat_every
+        self._backend_emitted = 0   # backend.events already re-emitted
+        self._last_ckpt_mono: Optional[float] = None
+        self._last_beat: Optional[float] = None
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -251,10 +268,32 @@ class CorpusCampaign:
         return os.path.join(self.checkpoint_dir, name)
 
     def _event(self, kind: str, detail: str = "", **kw) -> None:
+        # both clocks on purpose: wall (`t`) survives the checkpoint
+        # boundary so resumed sessions' events sort globally; monotonic
+        # (`mono`) is step-free within a session; `session` disambiguates
+        # when wall clocks of two sessions overlap or run backwards
         e = {"kind": kind, "detail": detail[:300],
-             "t": round(time.time(), 3)}
+             "t": round(time.time(), 3),
+             "mono": round(time.monotonic(), 3),
+             "session": self._session}
         e.update(kw)
         self._events.append(e)
+        obs_trace.event(kind, **{k: v for k, v in e.items() if k != "kind"})
+        obs_metrics.REGISTRY.counter(f"campaign_{kind}_total").inc()
+
+    def _emit_backend_events(self) -> None:
+        """Re-emit BackendManager events (probe/fallback/device-lost)
+        newly appended since the last call onto the trace bus, so the
+        one stream carries the backend story too. The report's
+        ``backend_events`` field is built from the original lists —
+        this is a mirror, not a move."""
+        if self.backend is None or not obs_trace.active():
+            return
+        new = self.backend.events[self._backend_emitted:]
+        self._backend_emitted += len(new)
+        for e in new:
+            obs_trace.event(e.get("kind", "backend"),
+                            **{k: v for k, v in e.items() if k != "kind"})
 
     def _load_ckpt(self) -> Dict:
         p = self._ckpt_path
@@ -306,6 +345,7 @@ class CorpusCampaign:
         # checksummed + fsynced + rotated: a crash never corrupts the
         # cursor, and even a torn rename leaves <p>.1 loadable
         save_json_checkpoint(p, state)
+        self._last_ckpt_mono = time.monotonic()
 
     # --- one engine pass -----------------------------------------------
     def _exec_batch(self, bi: int, names: List[str], codes: List[bytes],
@@ -546,6 +586,37 @@ class CorpusCampaign:
         out["status"] = f"quarantined:{len(out['quarantined'])}"
         return out
 
+    def _heartbeat(self, done: int, total: int, res: "CampaignResult",
+                   last_out: Dict) -> None:
+        """One line of live progress on stderr (plus a ``heartbeat``
+        event on the trace bus): contracts done, paths/s, frontier
+        occupancy, current rung, last-checkpoint age. The 10k-campaign
+        operator's 'is it still making progress, and at what cost'
+        pulse — without grepping four channels."""
+        wall = sum(res.batch_wall)
+        contracts = min(done * self.batch_size, len(self.contracts))
+        pps = res.paths_total / wall if wall else 0.0
+        # occupancy: the engine gauge when telemetry collected it this
+        # chunk, else a lane-capacity estimate from the last batch
+        occ = obs_metrics.REGISTRY.gauge("frontier_occupancy").value
+        if not occ:
+            cap = max(1, self.batch_size * self.lanes_per_contract)
+            occ = min(1.0, last_out.get("paths", 0) / cap)
+        rung = res.batch_status[-1] if res.batch_status else "-"
+        age = (time.monotonic() - self._last_ckpt_mono
+               if self._last_ckpt_mono is not None else None)
+        age_s = f"{age:.1f}s" if age is not None else "never"
+        print(f"heartbeat: batch {done}/{total} contracts {contracts}/"
+              f"{len(self.contracts)} paths/s {pps:.1f} frontier "
+              f"{100.0 * occ:.0f}% rung {rung} ckpt-age {age_s}",
+              file=sys.stderr, flush=True)
+        obs_trace.event("heartbeat", batch=done, batches_total=total,
+                        contracts=contracts,
+                        paths_per_sec=round(pps, 1),
+                        occupancy=round(occ, 4), rung=rung,
+                        ckpt_age=(round(age, 3) if age is not None
+                                  else None))
+
     # --- the campaign --------------------------------------------------
     def run(self, progress=None) -> CampaignResult:
         from ..smt.solver import SOLVER_STATS
@@ -590,9 +661,19 @@ class CorpusCampaign:
             if deadline is not None and time.monotonic() >= deadline:
                 break
             batch = self.contracts[bi * self.batch_size:(bi + 1) * self.batch_size]
-            t0 = time.monotonic()
-            out = self._run_batch_resilient(bi, batch)
-            dt = time.monotonic() - t0
+            with obs_trace.timer("batch", bi=bi, n=len(batch)) as sp:
+                out = self._run_batch_resilient(bi, batch)
+            dt = sp.elapsed
+            self._emit_backend_events()
+            obs_trace.event("batch_status", bi=bi, status=out["status"],
+                            dur=round(dt, 6))
+            reg = obs_metrics.REGISTRY
+            reg.counter("batches_total").inc()
+            reg.histogram("batch_seconds",
+                          help="per-batch wall time").observe(dt)
+            reg.counter("batch_retries_total").inc(out["retries"])
+            reg.counter("contracts_quarantined_total").inc(
+                len(out["quarantined"]))
             res.issues.extend(out["issues"])
             res.batch_wall.append(dt)
             res.paths_total += out["paths"]
@@ -624,8 +705,20 @@ class CorpusCampaign:
                 dirty = False
             else:
                 dirty = True
+            # solver gauges mirror the accumulated campaign totals —
+            # a scrape mid-run sees the whole-campaign split, like the
+            # final report will
+            for k, v in state["solver"].items():
+                if isinstance(v, (int, float)):
+                    reg.gauge(f"solver_{k}").set(v)
             if progress is not None:
                 progress(bi + 1, n_batches, dt, len(res.issues))
+            if self.heartbeat_every is not None:
+                now = time.monotonic()
+                if (self._last_beat is None
+                        or now - self._last_beat >= self.heartbeat_every):
+                    self._last_beat = now
+                    self._heartbeat(bi + 1, n_batches, res, out)
         if dirty:
             # deadline (or loop-exit) with unpersisted batches: flush so
             # the paid work survives the session
@@ -663,8 +756,18 @@ def merge_campaigns(results: Sequence[Dict]) -> Dict:
         "retries": sum(r.get("retries", 0) for r in results),
         "batch_status": [s for r in results
                          for s in (r.get("batch_status") or [])],
-        "backend_events": [e for r in results
-                           for e in (r.get("backend_events") or [])],
+        # per-session event ordering preserved: a plain concatenation
+        # interleaves resumed sessions' streams arbitrarily (host A's
+        # resume can carry events older than host B's first session).
+        # sorted() is stable, so events WITHIN one session keep their
+        # emission order even where timestamps tie or are missing;
+        # legacy events without session/t sort first as one group.
+        "backend_events": sorted(
+            (e for r in results for e in (r.get("backend_events") or [])),
+            key=lambda e: (str(e.get("session", "")),
+                           float(e.get("t", 0.0))
+                           if isinstance(e.get("t", 0.0), (int, float))
+                           else 0.0)),
     }
     wall = merged["wall_sec"]
     merged["contracts_per_sec"] = (

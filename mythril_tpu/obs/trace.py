@@ -1,0 +1,290 @@
+"""Span tracer: one ordered, schema'd event stream for the whole stack.
+
+Before this module, diagnosing a slow or degraded campaign meant
+grepping four disjoint channels (iprof histograms, ``CorpusCampaign``
+events, ``BackendManager`` events, ad-hoc ``time.monotonic()`` deltas in
+bench/tools). The tracer unifies them:
+
+- ``with trace.span("superstep", steps=64):`` times a phase and emits it
+  as BOTH a Chrome-trace event (open the ``--trace`` file in Perfetto /
+  ``chrome://tracing``) and one line of an append-only JSONL event log
+  with a versioned schema (``tools/trace_report.py`` summarizes it, the
+  soak asserts it);
+- ``trace.event("degrade", batch=3, step="halve-lanes")`` emits an
+  instant event — the campaign re-emits its existing ``_events`` /
+  ``backend.events`` channels here so the one stream carries everything
+  in order;
+- disabled (the default — no ``--trace`` flag), ``span()`` returns a
+  shared no-op singleton and ``event()`` returns immediately: no
+  allocation, no clock read, no file. Hot paths stay hot.
+
+The JSONL schema (version :data:`SCHEMA`): every line is one JSON object
+with at least ``kind`` (``"span"`` or an instant-event kind), ``t``
+(wall-clock ``time.time()``, seconds) and ``schema``. Spans add ``name``,
+``dur`` (seconds), ``mono`` (``time.monotonic()`` at span start — orders
+events within a session where wall time may step) and ``tid``; all
+``span(...)`` keyword attributes ride along verbatim. ``session`` is a
+per-process token so streams from resumed/merged sessions stay sortable
+(see ``merge_campaigns``).
+
+``timer()`` is the always-measuring variant: it returns a real
+:class:`Span` whose ``elapsed`` property works whether or not tracing is
+enabled (emitting only when it is). bench.py and the profilers use it in
+place of their former ad-hoc ``perf_counter``/``monotonic`` pairs, so
+one mechanism both measures and (when asked) records.
+
+Import cost is stdlib-only — no jax, no engine — so backend-free
+front-ends (``campaign-merge``, bench's pre-probe phase) can load it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: version stamped into every JSONL event (bump on breaking field
+#: changes; readers must reject newer-than-known schemas)
+SCHEMA = 1
+
+
+def jsonl_path_for(chrome_path: str) -> str:
+    """The JSONL event-log path derived from a ``--trace FILE``:
+    ``t.json -> t.jsonl``, anything else gets ``.jsonl`` appended."""
+    if chrome_path.endswith(".json"):
+        return chrome_path[:-5] + ".jsonl"
+    return chrome_path + ".jsonl"
+
+
+class Span:
+    """One timed phase. Context manager; ``elapsed`` is live inside the
+    ``with`` block (seconds since entry) and frozen to the final
+    duration after exit — callers can both drive budget loops off it
+    mid-flight and read the measurement afterwards."""
+
+    __slots__ = ("_tracer", "name", "attrs", "t_wall", "_t0", "dur")
+
+    def __init__(self, tracer: Optional["Tracer"], name: str,
+                 attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.t_wall = 0.0
+        self._t0 = 0.0
+        self.dur: Optional[float] = None
+
+    def __enter__(self) -> "Span":
+        self.t_wall = time.time()
+        self._t0 = time.monotonic()
+        return self
+
+    #: stopwatch use outside a ``with`` block (``sw = timer("x").start()``;
+    #: read ``sw.elapsed``; call ``sw.stop()`` if the span should emit)
+    start = __enter__
+
+    def stop(self) -> float:
+        self.__exit__(None, None, None)
+        return self.dur or 0.0
+
+    def __exit__(self, *exc) -> bool:
+        self.dur = time.monotonic() - self._t0
+        if self._tracer is not None:
+            self._tracer._emit_span(self)
+        return False
+
+    @property
+    def elapsed(self) -> float:
+        if self.dur is not None:
+            return self.dur
+        return time.monotonic() - self._t0
+
+
+class _NullSpan:
+    """The disabled-tracer singleton: zero state, zero clock reads.
+    ``elapsed`` is 0.0 — code that needs a measurement regardless of
+    tracing must use :func:`timer`, not :func:`span`."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    elapsed = 0.0
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Emits spans/events to an in-memory Chrome-trace buffer plus an
+    append-only JSONL log (flushed per event, so a killed run leaves a
+    readable prefix). Thread-safe; one per process is the normal case
+    (the module-level :func:`configure` installs it globally)."""
+
+    def __init__(self, chrome_path: Optional[str] = None,
+                 jsonl_path: Optional[str] = None):
+        self.chrome_path = chrome_path
+        self.jsonl_path = (jsonl_path if jsonl_path is not None
+                           else (jsonl_path_for(chrome_path)
+                                 if chrome_path else None))
+        self._lock = threading.Lock()
+        self._chrome: List[Dict] = []
+        self._t0_mono = time.monotonic()
+        self._t0_wall = time.time()
+        self._pid = os.getpid()
+        #: per-process token: orders/merges event streams across resumed
+        #: sessions and hosts (wall clocks may disagree; sessions don't)
+        self.session = f"{self._pid:x}-{int(self._t0_wall * 1000):x}"
+        self._fh = None
+        if self.jsonl_path:
+            d = os.path.dirname(os.path.abspath(self.jsonl_path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(self.jsonl_path, "a", encoding="utf-8")
+        self._closed = False
+
+    # --- emission ------------------------------------------------------
+    def _write_jsonl(self, rec: Dict) -> None:
+        if self._fh is None:
+            return
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            if not self._closed:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+
+    def _emit_span(self, sp: Span) -> None:
+        tid = threading.get_ident()
+        rec = {"schema": SCHEMA, "kind": "span", "name": sp.name,
+               "t": round(sp.t_wall, 6), "mono": round(sp._t0, 6),
+               "dur": round(sp.dur or 0.0, 6), "tid": tid,
+               "session": self.session}
+        for k, v in sp.attrs.items():
+            rec.setdefault(k, v)
+        self._write_jsonl(rec)
+        ev = {"name": sp.name, "ph": "X", "pid": self._pid, "tid": tid,
+              "ts": round((sp._t0 - self._t0_mono) * 1e6, 3),
+              "dur": round((sp.dur or 0.0) * 1e6, 3)}
+        if sp.attrs:
+            ev["args"] = dict(sp.attrs)
+        with self._lock:
+            self._chrome.append(ev)
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def event(self, kind: str, **attrs) -> None:
+        """Instant event (Chrome phase ``i``). ``attrs`` may carry its
+        own ``t``/``mono`` (a re-emitted historical event keeps its
+        original clock readings); missing ones are stamped now."""
+        now_wall = time.time()
+        now_mono = time.monotonic()
+        rec = {"schema": SCHEMA, "kind": kind,
+               "t": round(now_wall, 6), "mono": round(now_mono, 6),
+               "session": self.session}
+        rec.update(attrs)
+        self._write_jsonl(rec)
+        mono = rec.get("mono", now_mono)
+        if not isinstance(mono, (int, float)):
+            mono = now_mono
+        ev = {"name": kind, "ph": "i", "s": "p", "pid": self._pid,
+              "tid": threading.get_ident(),
+              "ts": round((mono - self._t0_mono) * 1e6, 3)}
+        args = {k: v for k, v in attrs.items() if k not in ("t", "mono")}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._chrome.append(ev)
+
+    # --- lifecycle -----------------------------------------------------
+    def flush(self) -> None:
+        """Write the Chrome-trace file now (idempotent; ``close`` calls
+        it). The JSONL log is already flushed per event."""
+        if not self.chrome_path:
+            return
+        with self._lock:
+            events = list(self._chrome)
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"schema": SCHEMA, "session": self.session,
+                             "t0_wall": round(self._t0_wall, 6)}}
+        tmp = f"{self.chrome_path}.{self._pid}.tmp"
+        d = os.path.dirname(os.path.abspath(self.chrome_path))
+        os.makedirs(d, exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, self.chrome_path)
+
+    def close(self) -> None:
+        self.flush()
+        with self._lock:
+            self._closed = True
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+# --- module-level API (the one most call sites use) --------------------
+
+_TRACER: Optional[Tracer] = None
+
+
+def configure(chrome_path: Optional[str] = None,
+              jsonl_path: Optional[str] = None) -> Tracer:
+    """Install the process-global tracer (replacing any previous one,
+    which is closed first). ``--trace t.json`` maps to
+    ``configure("t.json")`` → Chrome trace at ``t.json``, JSONL event
+    log at ``t.jsonl``."""
+    global _TRACER
+    if _TRACER is not None:
+        _TRACER.close()
+    _TRACER = Tracer(chrome_path, jsonl_path)
+    return _TRACER
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _TRACER
+
+
+def active() -> bool:
+    """True when a tracer is installed — gate EXPENSIVE collection
+    (device syncs, array reductions) on this, never plain span calls
+    (those are already near-free when disabled)."""
+    return _TRACER is not None
+
+
+def span(name: str, **attrs):
+    """Phase span on the global tracer; the shared no-op singleton when
+    tracing is off (zero allocation, zero clock reads)."""
+    t = _TRACER
+    if t is None:
+        return _NULL_SPAN
+    return t.span(name, **attrs)
+
+
+def timer(name: str, **attrs) -> Span:
+    """Always-measuring span: ``elapsed`` works with tracing off; the
+    event is emitted only when tracing is on. The replacement for
+    ad-hoc ``t0 = monotonic(); ...; dt = monotonic() - t0`` pairs."""
+    return Span(_TRACER, name, attrs)
+
+
+def event(kind: str, **attrs) -> None:
+    t = _TRACER
+    if t is not None:
+        t.event(kind, **attrs)
+
+
+def close() -> None:
+    """Close and uninstall the global tracer (writes the Chrome file)."""
+    global _TRACER
+    if _TRACER is not None:
+        _TRACER.close()
+        _TRACER = None
+
+
+__all__ = ["SCHEMA", "Span", "Tracer", "active", "close", "configure",
+           "event", "get_tracer", "jsonl_path_for", "span", "timer"]

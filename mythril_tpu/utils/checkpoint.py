@@ -36,6 +36,9 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 import jax
 
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
 log = logging.getLogger(__name__)
 
 #: current on-disk schema of both the npz frontier checkpoint and the
@@ -136,23 +139,28 @@ def save_frontier(path: str, sf, meta: Dict | None = None,
     """Serialize a SymFrontier (or any pytree of arrays) + meta to a
     versioned, checksummed npz, written durably (tmp + fsync + atomic
     rename) with the previous file rotated to ``<path>.1``."""
-    named, _ = _leaf_names(sf)
-    arrays = {}
-    leaf_sha: Dict[str, str] = {}
-    for i, (name, leaf) in enumerate(named):
-        arr = np.asarray(leaf)
-        arrays[f"leaf{i}::{name}"] = arr
-        leaf_sha[name] = _leaf_sha256(arr)
-    arrays["__meta__"] = np.frombuffer(
-        json.dumps(meta or {}).encode(), dtype=np.uint8)
-    arrays["__schema__"] = np.frombuffer(
-        json.dumps({"version": CHECKPOINT_SCHEMA,
-                    "leaf_sha256": leaf_sha}).encode(), dtype=np.uint8)
-    buf = io.BytesIO()
-    np.savez_compressed(buf, **arrays)
-    body = buf.getvalue()
-    digest = hashlib.sha256(body).hexdigest().encode()
-    _durable_write(path, body + _TRAILER_MAGIC + digest, rotate=rotate)
+    with obs_trace.timer("checkpoint_save", what="frontier",
+                         file=os.path.basename(path)) as sp:
+        named, _ = _leaf_names(sf)
+        arrays = {}
+        leaf_sha: Dict[str, str] = {}
+        for i, (name, leaf) in enumerate(named):
+            arr = np.asarray(leaf)
+            arrays[f"leaf{i}::{name}"] = arr
+            leaf_sha[name] = _leaf_sha256(arr)
+        arrays["__meta__"] = np.frombuffer(
+            json.dumps(meta or {}).encode(), dtype=np.uint8)
+        arrays["__schema__"] = np.frombuffer(
+            json.dumps({"version": CHECKPOINT_SCHEMA,
+                        "leaf_sha256": leaf_sha}).encode(), dtype=np.uint8)
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **arrays)
+        body = buf.getvalue()
+        digest = hashlib.sha256(body).hexdigest().encode()
+        _durable_write(path, body + _TRAILER_MAGIC + digest, rotate=rotate)
+    obs_metrics.REGISTRY.histogram(
+        "checkpoint_write_seconds",
+        help="durable checkpoint save latency").observe(sp.elapsed)
 
 
 def _read_npz_body(path: str) -> Tuple[bytes, bool]:
@@ -190,6 +198,16 @@ def load_frontier(path: str, template) -> Tuple[Any, Dict]:
     - ``ValueError`` — healthy file whose leaf SHAPES disagree with the
       template (a different lanes/limits config, not corruption).
     """
+    with obs_trace.timer("checkpoint_load", what="frontier",
+                         file=os.path.basename(path)) as sp:
+        out = _load_frontier_inner(path, template)
+    obs_metrics.REGISTRY.histogram(
+        "checkpoint_load_seconds",
+        help="checkpoint load+verify latency").observe(sp.elapsed)
+    return out
+
+
+def _load_frontier_inner(path: str, template) -> Tuple[Any, Dict]:
     body, had_trailer = _read_npz_body(path)
     try:
         # eager member reads: zip CRC errors surface lazily at access
@@ -293,11 +311,16 @@ def save_json_checkpoint(path: str, state: Dict, rotate: bool = True) -> None:
     """Durable, checksummed JSON state: the payload is wrapped as
     ``{"__schema__": 2, "sha256": <hex of canonical state>, "state":
     ...}`` and written tmp + fsync + rotate + atomic rename."""
-    payload = json.dumps(state, sort_keys=True)
-    doc = {"__schema__": CHECKPOINT_SCHEMA,
-           "sha256": hashlib.sha256(payload.encode()).hexdigest(),
-           "state": state}
-    _durable_write(path, json.dumps(doc).encode(), rotate=rotate)
+    with obs_trace.timer("checkpoint_save", what="campaign",
+                         file=os.path.basename(path)) as sp:
+        payload = json.dumps(state, sort_keys=True)
+        doc = {"__schema__": CHECKPOINT_SCHEMA,
+               "sha256": hashlib.sha256(payload.encode()).hexdigest(),
+               "state": state}
+        _durable_write(path, json.dumps(doc).encode(), rotate=rotate)
+    obs_metrics.REGISTRY.histogram(
+        "checkpoint_write_seconds",
+        help="durable checkpoint save latency").observe(sp.elapsed)
 
 
 def load_json_checkpoint(path: str) -> Dict:
@@ -305,6 +328,12 @@ def load_json_checkpoint(path: str) -> Dict:
     ``__schema__`` wrapper) loads as-is. Raises
     :class:`CheckpointCorrupt` on torn JSON / checksum mismatch /
     unsupported schema, ``FileNotFoundError`` when absent."""
+    with obs_trace.span("checkpoint_load", what="campaign",
+                        file=os.path.basename(path)):
+        return _load_json_checkpoint_inner(path)
+
+
+def _load_json_checkpoint_inner(path: str) -> Dict:
     with open(path, "rb") as fh:
         raw = fh.read()
     try:

@@ -1,0 +1,301 @@
+"""Telemetry spine (mythril_tpu/obs, docs/observability.md).
+
+All tests here are engine-free: the tracer/metrics layer is stdlib-only,
+and the campaign-side checks use the stub batch runner — the tier-1
+budget pays no XLA compile for observability coverage.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from mythril_tpu.obs import metrics as obs_metrics
+from mythril_tpu.obs import trace as obs_trace
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Every test starts and ends with no global tracer and a fresh
+    metrics registry — telemetry state must never leak between tests."""
+    obs_trace.close()
+    obs_metrics.REGISTRY.reset()
+    yield
+    obs_trace.close()
+    obs_metrics.REGISTRY.reset()
+
+
+def read_jsonl(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+# --- tracer -----------------------------------------------------------
+
+
+def test_span_nesting_and_schema_roundtrip(tmp_path):
+    t = str(tmp_path / "t.json")
+    obs_trace.configure(t)
+    with obs_trace.span("outer", bi=3, status="ok"):
+        time.sleep(0.01)
+        with obs_trace.span("inner", step="halve-lanes"):
+            time.sleep(0.002)
+    obs_trace.event("degrade", batch=3, step="cpu")
+    obs_trace.close()
+
+    events = read_jsonl(str(tmp_path / "t.jsonl"))
+    assert len(events) == 3
+    # required keys on EVERY event, span or instant
+    for e in events:
+        assert e["schema"] == obs_trace.SCHEMA
+        assert "kind" in e and "t" in e
+    # spans close inner-first; attributes round-trip verbatim
+    inner, outer, degrade = events
+    assert (inner["kind"], inner["name"]) == ("span", "inner")
+    assert inner["step"] == "halve-lanes"
+    assert (outer["name"], outer["bi"], outer["status"]) == ("outer", 3, "ok")
+    assert outer["dur"] >= inner["dur"] > 0
+    assert outer["mono"] <= inner["mono"]          # outer started first
+    assert degrade["kind"] == "degrade" and degrade["batch"] == 3
+    # both clocks on every event
+    assert all("mono" in e and "session" in e for e in events)
+
+
+def test_chrome_trace_json_validity(tmp_path):
+    t = str(tmp_path / "t.json")
+    obs_trace.configure(t)
+    with obs_trace.span("batch", bi=0):
+        pass
+    obs_trace.event("heartbeat", batch=1)
+    obs_trace.close()
+
+    doc = json.load(open(t))                       # valid JSON or raises
+    evs = doc["traceEvents"]
+    assert {e["name"] for e in evs} == {"batch", "heartbeat"}
+    for e in evs:
+        assert e["ph"] in ("X", "i")
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    x = next(e for e in evs if e["ph"] == "X")
+    assert x["dur"] >= 0 and x["args"] == {"bi": 0}
+
+
+def test_disabled_tracer_is_noop_and_touches_no_files(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    assert obs_trace.get_tracer() is None and not obs_trace.active()
+    # zero-allocation: every disabled span is the SAME shared singleton
+    s1, s2 = obs_trace.span("a", x=1), obs_trace.span("b")
+    assert s1 is s2
+    with s1:
+        pass
+    assert s1.elapsed == 0.0
+    assert obs_trace.event("degrade", batch=1) is None
+    # timer still measures with tracing off (bench/profilers rely on it)
+    with obs_trace.timer("measured") as sp:
+        time.sleep(0.005)
+    assert sp.elapsed >= 0.004
+    assert os.listdir(tmp_path) == []              # no file anywhere
+
+
+def test_timer_stopwatch_start_stop():
+    sw = obs_trace.timer("budget").start()
+    time.sleep(0.003)
+    live = sw.elapsed
+    assert live >= 0.002
+    dur = sw.stop()
+    assert dur >= live and sw.elapsed == dur       # frozen after stop
+
+
+def test_jsonl_path_derivation():
+    assert obs_trace.jsonl_path_for("t.json") == "t.jsonl"
+    assert obs_trace.jsonl_path_for("out/trace") == "out/trace.jsonl"
+
+
+# --- metrics ----------------------------------------------------------
+
+
+def test_metrics_snapshot_shape_and_prometheus():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("batches_total").inc()
+    reg.counter("batches_total").inc(2)
+    reg.gauge("frontier_occupancy").set(0.75)
+    h = reg.histogram("batch_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(30.0)
+
+    snap = reg.snapshot()
+    assert snap["schema"] == obs_metrics.SCHEMA and "t" in snap
+    assert snap["counters"]["batches_total"] == 3.0
+    assert snap["gauges"]["frontier_occupancy"] == 0.75
+    hs = snap["histograms"]["batch_seconds"]
+    assert (hs["count"], hs["min"], hs["max"]) == (3, 0.05, 30.0)
+    assert hs["sum"] == pytest.approx(30.55)
+    # cumulative le semantics, +Inf covers everything
+    assert hs["buckets"] == {"0.1": 1, "1.0": 2, "+Inf": 3}
+
+    prom = reg.to_prometheus()
+    assert "# TYPE mythril_batches_total counter" in prom
+    assert "mythril_batches_total 3" in prom
+    assert "# TYPE mythril_batch_seconds histogram" in prom
+    assert 'mythril_batch_seconds_bucket{le="+Inf"} 3' in prom
+    assert "mythril_batch_seconds_count 3" in prom
+    # same-name re-registration under a different type is a bug
+    with pytest.raises(TypeError):
+        reg.gauge("batches_total")
+
+
+def test_metrics_write_json_and_prom(tmp_path):
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("c").inc()
+    j = str(tmp_path / "m.json")
+    p = str(tmp_path / "m.prom")
+    reg.write(j)
+    reg.write(p)
+    assert json.load(open(j))["counters"]["c"] == 1.0
+    assert "mythril_c 1" in open(p).read()
+
+
+# --- campaign integration (stub runner — no engine) -------------------
+
+N = 6
+STUB_CONTRACTS = [(f"c{i:03d}", b"\x00") for i in range(N)]
+
+
+def _stub_runner(bi, names, codes, lanes=None, width=None):
+    return {"issues": [], "paths": len(names), "dropped": 0, "iprof": {}}
+
+
+def _campaign(ckpt, fault=None, **kw):
+    from mythril_tpu.mythril.campaign import CorpusCampaign
+    from mythril_tpu.resilience import FaultInjector
+
+    return CorpusCampaign(
+        STUB_CONTRACTS, batch_size=2, checkpoint_dir=ckpt, spec=object(),
+        batch_timeout=5.0, batch_runner=_stub_runner,
+        fault_injector=FaultInjector.from_string(fault), **kw)
+
+
+def test_campaign_events_carry_wall_mono_and_session(tmp_path):
+    res = _campaign(str(tmp_path / "ck"), "oom:batch=1:times=1").run()
+    degr = [e for e in res.backend_events if e["kind"] == "degrade"]
+    assert degr
+    for e in degr:
+        assert e["t"] > 1e9                        # wall clock (epoch)
+        assert isinstance(e["mono"], float)        # monotonic clock
+        assert isinstance(e["session"], str) and e["session"]
+    # one campaign instance = one session token on all its events
+    assert len({e["session"] for e in degr}) == 1
+
+
+def test_campaign_trace_bus_and_heartbeat_cadence(tmp_path, capsys):
+    t = str(tmp_path / "t.json")
+    obs_trace.configure(t)
+    # heartbeat_every=0: a beat after EVERY batch
+    res = _campaign(str(tmp_path / "ck"), heartbeat_every=0.0).run()
+    obs_trace.close()
+    assert res.batches == 3
+    beats = [line for line in capsys.readouterr().err.splitlines()
+             if line.startswith("heartbeat: ")]
+    assert len(beats) == 3
+    # the pulse carries the promised fields
+    assert "contracts 6/6" in beats[-1]
+    assert "paths/s" in beats[-1] and "ckpt-age" in beats[-1]
+    events = read_jsonl(str(tmp_path / "t.jsonl"))
+    kinds = [e["kind"] for e in events]
+    assert kinds.count("heartbeat") == 3
+    assert kinds.count("batch_status") == 3
+    assert sum(1 for e in events
+               if e["kind"] == "span" and e["name"] == "batch") == 3
+    # every bus event satisfies the soak's schema contract
+    assert all("kind" in e and "t" in e and "schema" in e for e in events)
+
+
+def test_campaign_heartbeat_rate_limited(tmp_path, capsys):
+    # a huge interval -> exactly one beat (the immediate first one)
+    _campaign(str(tmp_path / "ck"), heartbeat_every=3600.0).run()
+    beats = [line for line in capsys.readouterr().err.splitlines()
+             if line.startswith("heartbeat: ")]
+    assert len(beats) == 1
+
+
+def test_campaign_batch_metrics(tmp_path):
+    _campaign(str(tmp_path / "ck"), "raise:contract=c002").run()
+    snap = obs_metrics.REGISTRY.snapshot()
+    assert snap["counters"]["batches_total"] == 3.0
+    assert snap["counters"]["contracts_quarantined_total"] == 1.0
+    assert snap["counters"]["batch_retries_total"] == 1.0
+    assert snap["histograms"]["batch_seconds"]["count"] == 3
+    assert snap["histograms"]["checkpoint_write_seconds"]["count"] >= 3
+
+
+def test_merge_campaigns_orders_events_by_session_then_time():
+    from mythril_tpu.mythril.campaign import merge_campaigns
+
+    # host A resumed once: session a1 (t 10..11) then a2 (t 20..21);
+    # host B's single session overlaps both in wall time. Concatenation
+    # order deliberately interleaves; the merge must group per session
+    # and order within each by timestamp, stably.
+    ra = {"backend_events": [
+        {"kind": "x1", "t": 20.0, "session": "a2"},
+        {"kind": "x2", "t": 21.0, "session": "a2"},
+        {"kind": "x3", "t": 10.0, "session": "a1"},
+        {"kind": "tie1", "t": 11.0, "session": "a1"},
+        {"kind": "tie2", "t": 11.0, "session": "a1"},
+    ]}
+    rb = {"backend_events": [{"kind": "y1", "t": 15.0, "session": "b1"}]}
+    got = merge_campaigns([ra, rb])["backend_events"]
+    assert [e["kind"] for e in got] == ["x3", "tie1", "tie2", "x1", "x2",
+                                       "y1"]
+    # legacy events without session/t keep their relative order, first
+    legacy = {"backend_events": [{"kind": "old1"}, {"kind": "old2"}]}
+    got = merge_campaigns([legacy, rb])["backend_events"]
+    assert [e["kind"] for e in got] == ["old1", "old2", "y1"]
+
+
+def test_checkpoint_save_emits_span_and_latency(tmp_path):
+    from mythril_tpu.utils.checkpoint import (load_json_checkpoint,
+                                              save_json_checkpoint)
+
+    t = str(tmp_path / "t.json")
+    obs_trace.configure(t)
+    p = str(tmp_path / "state.json")
+    save_json_checkpoint(p, {"next_batch": 2})
+    assert load_json_checkpoint(p)["next_batch"] == 2
+    obs_trace.close()
+    names = [e.get("name") for e in read_jsonl(str(tmp_path / "t.jsonl"))]
+    assert "checkpoint_save" in names and "checkpoint_load" in names
+    h = obs_metrics.REGISTRY.snapshot()["histograms"]
+    assert h["checkpoint_write_seconds"]["count"] == 1
+
+
+# --- trace_report tool ------------------------------------------------
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(ROOT, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_summarizes_both_formats(tmp_path, capsys):
+    t = str(tmp_path / "t.json")
+    obs_trace.configure(t)
+    _campaign(str(tmp_path / "ck"), "oom:batch=1:times=1").run()
+    obs_trace.close()
+
+    tr = _load_trace_report()
+    for path in (t, str(tmp_path / "t.jsonl")):
+        assert tr.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "top spans by total wall time" in out
+        assert "batch stall table" in out
+        assert "halve-lanes" in out                # degrade timeline row
+        assert "checkpoint_save" in out or "saves:" in out
+    assert tr.main([str(tmp_path / "nope.json")]) == 2

@@ -18,7 +18,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -47,7 +46,13 @@ import numpy as np
 from mythril_tpu.config import DEFAULT_LIMITS
 from mythril_tpu.core import run
 from mythril_tpu.core import interpreter as ci
+from mythril_tpu.obs import trace as obs_trace
 from mythril_tpu.workloads import erc20_transfer_workload
+
+# PROF_TRACE=FILE: record every timed section as a span in a
+# Perfetto-loadable trace (same spine the campaign's --trace uses)
+if os.environ.get("PROF_TRACE"):
+    obs_trace.configure(os.environ["PROF_TRACE"])
 
 P = int(os.environ.get("PROF_P", "4096"))
 MAX_STEPS = int(os.environ.get("PROF_STEPS", "256"))
@@ -67,14 +72,14 @@ CLASS_OP = {
 }
 
 
-def timed(fn, *args, reps=REPS):
+def timed(fn, *args, reps=REPS, label="timed"):
     out = fn(*args)
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
+    with obs_trace.timer(f"profile.{label}", reps=reps) as sp:
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+    return sp.elapsed / reps
 
 
 def tree_bytes(t) -> int:
@@ -175,7 +180,7 @@ def main():
         if name not in sel:
             continue
         runner = make_runner(cc)
-        dt = timed(runner, f, reps=REPS)
+        dt = timed(runner, f, reps=REPS, label=name)
         out = runner(f)
         if name == "all_cond":
             ac = (int(np.asarray(out.n_steps).sum()), dt)
@@ -189,11 +194,11 @@ def main():
         prof[f"{name}_steps_max"] = steps
     if "skeleton" in sel:
         sk = make_runner((), skeleton=True)
-        dt = timed(sk, f, reps=REPS)
+        dt = timed(sk, f, reps=REPS, label="skeleton")
         prof["skeleton_superstep_ms"] = round(dt / MAX_STEPS * 1e3, 4)
     if "empty_conds" in sel:
         ec = make_empty_cond_runner()
-        dt = timed(ec, f, reps=REPS)
+        dt = timed(ec, f, reps=REPS, label="empty_conds")
         prof["empty_conds_superstep_ms"] = round(dt / MAX_STEPS * 1e3, 4)
 
     if out is not None:
@@ -250,4 +255,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    finally:
+        obs_trace.close()  # writes the PROF_TRACE Chrome file, if any

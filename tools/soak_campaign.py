@@ -19,7 +19,14 @@ Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
   5. torn-checkpoint — kill mid-campaign, then truncate the newest
      checkpoint mid-file (a kill -9 DURING the checkpoint write); the
      resume must fall back to the rotated last-known-good copy and
-     converge to leg 2's final state with nothing double-counted.
+     converge to leg 2's final state with nothing double-counted;
+  6. telemetry — the same fault-injected campaign run with the trace +
+     metrics + heartbeat spine on (docs/observability.md): the emitted
+     JSONL must parse line-by-line with the required schema keys
+     (``kind``, ``t``, ``schema``) on EVERY event, the Chrome trace
+     must be valid JSON with superstep/batch/checkpoint spans and
+     degrade events, and the metrics snapshot must carry the campaign
+     counters.
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
@@ -76,7 +83,7 @@ KILLABLE = assemble(0, "SELFDESTRUCT")
 SAFE = assemble(1, 0, "SSTORE", "STOP")
 N = 6  # even indices killable -> expected issues c000/c002/c004
 
-LEGS = ("transient", "poison", "kill_resume", "oom", "torn")
+LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry")
 
 
 def write_corpus(d: str) -> str:
@@ -203,6 +210,60 @@ def main() -> int:
                    and r5.batches == 2
                    and legs["torn"]["quarantined"] == ["c002"]
                    and legs["torn"]["issues"] == legs["poison"]["issues"])
+
+        if "telemetry" in want:
+            # leg 6: the --trace/--metrics/--heartbeat spine on a real
+            # fault-injected campaign — every emitted JSONL event must
+            # parse and carry the schema'd required keys
+            from mythril_tpu.obs import metrics as obs_metrics
+            from mythril_tpu.obs import trace as obs_trace
+
+            tpath = os.path.join(d, "t.json")
+            jpath = obs_trace.jsonl_path_for(tpath)
+            mpath = os.path.join(d, "m.json")
+            obs_trace.configure(tpath)
+            obs_metrics.REGISTRY.enabled = True
+            try:
+                r6 = campaign(corpus, os.path.join(d, "ck6"),
+                              "oom:batch=0:times=1",
+                              heartbeat_every=0.0).run()
+            finally:
+                obs_trace.close()
+                obs_metrics.REGISTRY.write(mpath)
+            events = []
+            parse_ok = True
+            with open(jpath) as fh:
+                for line in fh:
+                    if not line.strip():
+                        continue
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        parse_ok = False
+            keys_ok = bool(events) and all(
+                "kind" in e and "t" in e and "schema" in e
+                for e in events)
+            with open(tpath) as fh:
+                chrome = json.load(fh)
+            names = {e.get("name") for e in chrome.get("traceEvents", [])}
+            snap = json.load(open(mpath))
+            legs["telemetry"] = {
+                "events": len(events), "parse_ok": parse_ok,
+                "keys_ok": keys_ok,
+                "span_names": sorted(n for n in names if n),
+                "heartbeats": sum(1 for e in events
+                                  if e.get("kind") == "heartbeat"),
+                "batches_total": snap.get("counters", {}).get(
+                    "batches_total"),
+            }
+            ok &= (parse_ok and keys_ok
+                   and {"superstep", "batch",
+                        "checkpoint_save", "degrade"} <= names
+                   and legs["telemetry"]["heartbeats"] >= 1
+                   and snap.get("counters", {}).get("batches_total") == 2
+                   and not r6.quarantined
+                   and sorted(i["contract"] for i in r6.issues)
+                   == ["c000", "c002", "c004"])
 
     print(json.dumps({"ok": bool(ok), "legs": legs}))
     return 0 if ok else 1

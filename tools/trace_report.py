@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Summarize a telemetry trace (docs/observability.md).
+
+Reads either output of the span tracer — the Chrome-trace JSON
+(``--trace t.json``) or the JSONL event log (``t.jsonl``) — and prints:
+
+  1. top spans by total wall time (count / total / mean / max per name),
+  2. a batch stall table (slowest campaign batches with their status),
+  3. the degrade timeline (every ladder step, in order),
+  4. a checkpoint summary (saves/loads, total and worst latency).
+
+Usage:
+    python tools/trace_report.py t.json [--top N]
+    python tools/trace_report.py t.jsonl
+
+Stdlib-only (no jax, no engine import): runs anywhere, including on a
+laptop against a trace scp'd off a pod host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def load_trace(path: str) -> Tuple[List[Dict], List[Dict]]:
+    """``(spans, instants)`` from either trace format.
+
+    Spans normalize to ``{"name", "dur" (sec), "args" {...}}``;
+    instants to ``{"kind", "t" (sec, wall or trace-relative), "args"}``.
+    """
+    with open(path, encoding="utf-8") as fh:
+        head = fh.read(1)
+        fh.seek(0)
+        if head == "{" and not path.endswith(".jsonl"):
+            doc = json.load(fh)
+            if isinstance(doc, dict) and "traceEvents" in doc:
+                return _from_chrome(doc["traceEvents"])
+            # a single JSON object that isn't a chrome trace: treat the
+            # one object as one event line
+            lines: List[Dict] = [doc] if isinstance(doc, dict) else []
+        else:
+            lines = []
+            for i, raw in enumerate(fh):
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    lines.append(json.loads(raw))
+                except ValueError as e:
+                    raise SystemExit(
+                        f"error: {path}:{i + 1}: unparseable JSONL ({e})")
+    return _from_jsonl(lines)
+
+
+def _from_chrome(events: List[Dict]) -> Tuple[List[Dict], List[Dict]]:
+    spans, instants = [], []
+    for e in events:
+        ph = e.get("ph")
+        if ph == "X":
+            spans.append({"name": e.get("name", "?"),
+                          "dur": float(e.get("dur", 0.0)) / 1e6,
+                          "args": e.get("args", {}) or {}})
+        elif ph == "i":
+            instants.append({"kind": e.get("name", "?"),
+                             "t": float(e.get("ts", 0.0)) / 1e6,
+                             "args": e.get("args", {}) or {}})
+    return spans, instants
+
+
+def _from_jsonl(lines: List[Dict]) -> Tuple[List[Dict], List[Dict]]:
+    spans, instants = [], []
+    meta = {"schema", "kind", "name", "t", "mono", "dur", "tid", "session"}
+    for e in lines:
+        args = {k: v for k, v in e.items() if k not in meta}
+        if e.get("kind") == "span":
+            spans.append({"name": e.get("name", "?"),
+                          "dur": float(e.get("dur", 0.0)), "args": args})
+        else:
+            t = e.get("t", 0.0)
+            instants.append({"kind": e.get("kind", "?"),
+                             "t": float(t) if isinstance(t, (int, float))
+                             else 0.0,
+                             "args": args})
+    return spans, instants
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 100:
+        return f"{v:8.1f}s"
+    if v >= 0.1:
+        return f"{v:8.3f}s"
+    return f"{v * 1e3:7.2f}ms"
+
+
+def report(spans: List[Dict], instants: List[Dict], top: int = 10) -> str:
+    out: List[str] = []
+
+    # 1. top spans by total wall time
+    agg: Dict[str, List[float]] = {}
+    for s in spans:
+        agg.setdefault(s["name"], []).append(s["dur"])
+    out.append("== top spans by total wall time ==")
+    if agg:
+        out.append(f"{'span':<18}{'count':>7}{'total':>10}{'mean':>10}"
+                   f"{'max':>10}")
+        rows = sorted(agg.items(), key=lambda kv: -sum(kv[1]))[:top]
+        for name, durs in rows:
+            out.append(f"{name:<18}{len(durs):>7}{_fmt_s(sum(durs)):>10}"
+                       f"{_fmt_s(sum(durs) / len(durs)):>10}"
+                       f"{_fmt_s(max(durs)):>10}")
+    else:
+        out.append("(no spans)")
+
+    # 2. batch stall table: slowest batches, with their outcome
+    status_by_bi: Dict[int, str] = {}
+    for e in instants:
+        if e["kind"] == "batch_status" and "bi" in e["args"]:
+            status_by_bi[int(e["args"]["bi"])] = str(
+                e["args"].get("status", "?"))
+    batches = [s for s in spans if s["name"] == "batch"]
+    out.append("")
+    out.append("== batch stall table (slowest first) ==")
+    if batches:
+        mean = sum(b["dur"] for b in batches) / len(batches)
+        out.append(f"{'batch':>6}{'wall':>10}{'x mean':>8}  status")
+        for b in sorted(batches, key=lambda b: -b["dur"])[:top]:
+            bi = b["args"].get("bi", "?")
+            status = status_by_bi.get(
+                int(bi) if isinstance(bi, (int, float)) else -1, "")
+            ratio = b["dur"] / mean if mean else 0.0
+            out.append(f"{bi!s:>6}{_fmt_s(b['dur']):>10}{ratio:>7.1f}x"
+                       f"  {status}")
+    else:
+        out.append("(no batch spans — not a campaign trace?)")
+
+    # 3. degrade timeline
+    degr = sorted((e for e in instants
+                   if e["kind"] in ("degrade", "degrade_ok")),
+                  key=lambda e: e["t"])
+    out.append("")
+    out.append("== degrade timeline ==")
+    if degr:
+        t0 = degr[0]["t"]
+        for e in degr:
+            a = e["args"]
+            if e["kind"] == "degrade":
+                out.append(
+                    f"+{e['t'] - t0:8.2f}s batch {a.get('batch', '?')}: "
+                    f"{a.get('step', '?')} -> lanes={a.get('lanes', '?')} "
+                    f"width={a.get('width', '?')} "
+                    f"({str(a.get('detail', ''))[:60]})")
+            else:
+                out.append(f"+{e['t'] - t0:8.2f}s batch "
+                           f"{a.get('batch', '?')}: recovered at rung "
+                           f"{a.get('step', '?')}")
+    else:
+        out.append("(no degrade events — the run never hit "
+                   "RESOURCE_EXHAUSTED)")
+
+    # 4. checkpoint summary
+    saves = [s for s in spans if s["name"] == "checkpoint_save"]
+    loads = [s for s in spans if s["name"] == "checkpoint_load"]
+    out.append("")
+    out.append("== checkpoints ==")
+    if saves or loads:
+        if saves:
+            out.append(f"saves: {len(saves)}  total "
+                       f"{_fmt_s(sum(s['dur'] for s in saves)).strip()}  "
+                       f"worst {_fmt_s(max(s['dur'] for s in saves)).strip()}")
+        if loads:
+            out.append(f"loads: {len(loads)}  total "
+                       f"{_fmt_s(sum(s['dur'] for s in loads)).strip()}  "
+                       f"worst {_fmt_s(max(s['dur'] for s in loads)).strip()}")
+    else:
+        out.append("(no checkpoint spans)")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome-trace JSON (--trace output) or "
+                                  "its JSONL event log")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows per table (default 10)")
+    args = ap.parse_args(argv)
+    try:
+        spans, instants = load_trace(args.trace)
+    except FileNotFoundError:
+        print(f"error: no such trace file: {args.trace}", file=sys.stderr)
+        return 2
+    print(report(spans, instants, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
