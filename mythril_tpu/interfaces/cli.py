@@ -384,10 +384,20 @@ def exec_analyze(args) -> int:
     try:
         return _exec_analyze_inner(args)
     finally:
+        # best-effort: a failed telemetry flush (unwritable dir, full
+        # disk) must not mask the analysis result or its exception
         if getattr(args, "trace", None):
-            obs_trace.close()
+            try:
+                obs_trace.close()
+            except Exception as exc:
+                print(f"warning: trace write failed: {exc}",
+                      file=sys.stderr)
         if getattr(args, "metrics", None):
-            obs_metrics.REGISTRY.write(args.metrics)
+            try:
+                obs_metrics.REGISTRY.write(args.metrics)
+            except Exception as exc:
+                print(f"warning: metrics write failed: {exc}",
+                      file=sys.stderr)
 
 
 def _exec_analyze_inner(args) -> int:

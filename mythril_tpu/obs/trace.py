@@ -114,6 +114,13 @@ class _NullSpan:
     def __exit__(self, *exc) -> bool:
         return False
 
+    # mirror Span's stopwatch surface so ``span(...).start()`` /
+    # ``.stop()`` stay safe when tracing is disabled
+    start = __enter__
+
+    def stop(self) -> float:
+        return 0.0
+
     elapsed = 0.0
 
 

@@ -222,6 +222,10 @@ def main() -> int:
             jpath = obs_trace.jsonl_path_for(tpath)
             mpath = os.path.join(d, "m.json")
             obs_trace.configure(tpath)
+            # legs 1-5 already incremented the process-global registry
+            # (counters tick even while disabled); start this leg clean
+            # so the batches_total assertion sees only its own campaign
+            obs_metrics.REGISTRY.reset()
             obs_metrics.REGISTRY.enabled = True
             try:
                 r6 = campaign(corpus, os.path.join(d, "ck6"),
