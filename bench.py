@@ -195,6 +195,40 @@ def bench_analyze() -> dict:
     }
 
 
+def bench_sweep(remaining) -> None:
+    """``BENCH_SWEEP=1`` lane-scaling sweep: the SYMBOLIC engine at
+    P ∈ {1024, 4096, 16384} (override: ``BENCH_SWEEP_P=comma,list``),
+    ONE JSON record per P on stdout. Exists so the 4096→16384
+    throughput cliff measured on the last TPU round (1.08M → 771k
+    lane-steps/s) is tracked per-PR instead of anecdotally — a scaling
+    regression shows up as a changed P-curve, not a vibe. ``remaining``
+    is the budget callable; a P whose run would not fit is emitted as a
+    skipped record rather than silently dropped."""
+    global SYM_P
+    ps = [int(x) for x in
+          os.environ.get("BENCH_SWEEP_P", "1024,4096,16384").split(",")
+          if x.strip()]
+    for p in ps:
+        if remaining() < 120:
+            print(json.dumps({"metric": "sym_lane_steps_per_sec", "P": p,
+                              "skipped": "budget: %.0fs left" % remaining()}),
+                  flush=True)
+            continue
+        SYM_P = p
+        try:
+            with obs_trace.timer("bench.sweep", P=p):
+                rec = bench_symbolic()
+        except Exception as e:  # one failing shape must not end the sweep
+            print(json.dumps({"metric": "sym_lane_steps_per_sec", "P": p,
+                              "error": repr(e)[:300]}), flush=True)
+            continue
+        print(json.dumps({"metric": "sym_lane_steps_per_sec", "P": p,
+                          "value": rec["sym_lane_steps_per_sec"],
+                          "unit": "lane-steps/s",
+                          "platform": jax.default_backend(),
+                          "extra": rec}), flush=True)
+
+
 def bench_profile(timeout_s: float = 600.0) -> dict:
     """Superstep time breakdown (VERDICT r3 ask #1b): per-variant dispatch
     cost + bandwidth floor, via tools/profile_superstep.py in a subprocess
@@ -375,6 +409,16 @@ def main():
             return
 
     _lazy_imports()
+    if os.environ.get("BENCH_SWEEP"):
+        # lane-scaling sweep mode: per-P records instead of the single
+        # headline line; suppress the watchdog's error-shaped emit —
+        # the sweep's own records are the output
+        global _EMITTED
+        bench_sweep(remaining)
+        sw.stop()
+        with _EMIT_LOCK:
+            _EMITTED = True
+        return
     try:
         value, vs, err = bench_concrete()
     except Exception as e:

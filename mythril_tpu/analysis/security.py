@@ -19,7 +19,8 @@ log = logging.getLogger(__name__)
 
 
 def fire_lasers(target, white_list: Optional[List[str]] = None,
-                parallel: bool = False) -> Report:
+                parallel: bool = False,
+                workers: Optional[int] = None) -> Report:
     """`target` is an AnalysisContext or a SymExecWrapper; a wrapper's
     per-transaction context snapshots are all scanned (module issue caches
     dedup repeat findings across txs). Witness-search statistics are
@@ -31,8 +32,10 @@ def fire_lasers(target, white_list: Optional[List[str]] = None,
     detection modules of each tx context concurrently in a thread pool:
     the witness search is host Python whose hot loop sits in the native C
     tape evaluator, so module-level threads overlap the GIL-released
-    evaluator calls. Per-module solver accounting is serial-only (the
-    process-wide counter can't attribute interleaved deltas)."""
+    evaluator calls. ``workers`` caps that pool (the campaign's
+    ``--solver-workers`` flag; default: min(8, #modules)). Per-module
+    solver accounting is serial-only (the process-wide counter can't
+    attribute interleaved deltas)."""
     from ..smt.solver import SOLVER_STATS
 
     contexts = getattr(target, "tx_contexts", None) or [target]
@@ -71,7 +74,7 @@ def fire_lasers(target, white_list: Optional[List[str]] = None,
             if len(lanes):
                 ctx.tape(int(lanes[0]))
             with ThreadPoolExecutor(
-                    max_workers=min(8, len(modules))) as pool:
+                    max_workers=min(workers or 8, len(modules))) as pool:
                 for issues in pool.map(lambda m: run_module(m, ctx), modules):
                     for issue in issues:
                         report.append(issue)

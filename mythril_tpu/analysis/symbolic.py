@@ -438,14 +438,25 @@ class SymExecWrapper:
                     sec_per_step = max(sec_per_step, sp.elapsed / n)
                 obs_metrics.REGISTRY.counter("engine_supersteps_total").inc(n)
                 steps_done += n
+                # ONE device→host fetch of (active, fork_req) per chunk
+                # boundary, shared by the rebalance planner and the
+                # telemetry gauges — each np.asarray is a blocking sync,
+                # and the gauges used to pay a second one of their own
+                act_h = freq_h = None
+                if self.spill or (obs_metrics.REGISTRY.enabled
+                                  or obs_trace.active()):
+                    act_h = np.asarray(sf.base.active)
+                    freq_h = np.asarray(sf.fork_req)
                 if self.spill:
                     with obs_trace.span("rebalance", tx=self._cur_tx):
-                        sf, moved = rebalance_parked(sf, self.fork_block)
+                        sf, moved = rebalance_parked(sf, self.fork_block,
+                                                     active=act_h,
+                                                     fork_req=freq_h)
                     self._rebalanced += moved
                     obs_metrics.REGISTRY.counter(
                         "rebalanced_lanes_total",
                         help="parked lanes re-seeded at host seams").inc(moved)
-                self._observe_frontier(sf)
+                self._observe_frontier(sf, active=act_h, fork_req=freq_h)
                 self.plugin_loader.fire("on_chunk", sf, steps_done)
                 if self.checkpoint_dir is not None:
                     self._save_checkpoint(sf, steps_done)
@@ -462,9 +473,12 @@ class SymExecWrapper:
                 # get bounded extra chunks (reference analog: the work
                 # list drains until empty or timeout)
                 with obs_trace.span("drain", tx=self._cur_tx):
+                    # one fetch per drain round, shared with the
+                    # rebalance planner and the final parked count
+                    act_h = np.asarray(sf.base.active)
+                    freq_h = np.asarray(sf.fork_req)
+                    parked = freq_h & act_h
                     for _ in range(4):
-                        parked = (np.asarray(sf.fork_req)
-                                  & np.asarray(sf.base.active))
                         if not parked.any():
                             break
                         if self.timed_out or (
@@ -472,7 +486,9 @@ class SymExecWrapper:
                                 and _time.monotonic() >= self._deadline_at):
                             break  # the drain respects the wall clock too
                         with obs_trace.span("rebalance", tx=self._cur_tx):
-                            sf, moved = rebalance_parked(sf, self.fork_block)
+                            sf, moved = rebalance_parked(
+                                sf, self.fork_block,
+                                active=act_h, fork_req=freq_h)
                         self._rebalanced += moved
                         obs_metrics.REGISTRY.counter(
                             "rebalanced_lanes_total").inc(moved)
@@ -487,10 +503,13 @@ class SymExecWrapper:
                                 defer_starved=True,
                                 migrate_every=self.migrate_every)
                         self._visited |= np.asarray(vis)
+                        act_h = np.asarray(sf.base.active)
+                        freq_h = np.asarray(sf.fork_req)
+                        parked = freq_h & act_h
                 # forks still parked after draining are lost coverage —
-                # count them in the drop channel for honesty
-                self._parked_end += int(
-                    (np.asarray(sf.fork_req) & np.asarray(sf.base.active)).sum())
+                # count them in the drop channel for honesty (reusing
+                # the drain loop's final fetch — no extra sync)
+                self._parked_end += int(parked.sum())
             return sf
 
         def run_one_tx(sf, is_last: bool, handoff_kw=None):
@@ -704,15 +723,22 @@ class SymExecWrapper:
                  for a, h in zip(self.dynld_loaded, self._dynld_sha)]},
         )
 
-    def _observe_frontier(self, sf) -> None:
-        """Frontier occupancy / park gauges after a chunk. The reads are
-        host transfers (device sync), so they run only when telemetry is
-        actually on — a bare run must not pay them."""
+    def _observe_frontier(self, sf, active=None, fork_req=None) -> None:
+        """Frontier occupancy / park gauges after a chunk. ``active``/
+        ``fork_req`` accept the chunk boundary's already-fetched host
+        arrays (the spill/rebalance path pulls them anyway), so the
+        gauges never force an EXTRA blocking device→host sync; absent
+        them, the reads happen here and only when telemetry is actually
+        on — a bare run must not pay them. (A rebalance between the
+        shared fetch and this call is harmless: it relocates lanes
+        without changing the active or parked COUNTS, which is all the
+        gauges report.)"""
         reg = obs_metrics.REGISTRY
         if not (reg.enabled or obs_trace.active()):
             return
-        act = np.asarray(sf.base.active)
-        parked = int((np.asarray(sf.fork_req) & act).sum())
+        act = np.asarray(sf.base.active) if active is None else active
+        freq = np.asarray(sf.fork_req) if fork_req is None else fork_req
+        parked = int((freq & act).sum())
         reg.gauge("frontier_active_lanes",
                   help="live lanes after the last chunk").set(float(act.sum()))
         reg.gauge("frontier_occupancy",

@@ -154,6 +154,24 @@ def create_parser() -> argparse.ArgumentParser:
                         "'halve-batch', 'cpu' (default: all three in "
                         "that order); 'none' disables degradation — an "
                         "OOM then falls to retry/bisect")
+    a.add_argument("--pipeline", dest="pipeline", action="store_true",
+                   default=True,
+                   help="campaign mode (default ON): overlap batch i's "
+                        "host phase (detection modules + witness "
+                        "search) with batch i+1's device execution, "
+                        "and write checkpoints from a background "
+                        "thread; results are byte-identical to "
+                        "--no-pipeline and any fault drains back to "
+                        "the serial retry/bisect path (see "
+                        "docs/performance.md)")
+    a.add_argument("--no-pipeline", dest="pipeline", action="store_false",
+                   help="campaign mode: strictly serial batches "
+                        "(device and host phases never overlap)")
+    a.add_argument("--solver-workers", type=int, default=1, metavar="N",
+                   help="threads for the detection-module/witness-"
+                        "search pool in the campaign host phase "
+                        "(N>1 implies --parallel-solving with an "
+                        "N-thread pool; default 1)")
     a.add_argument("--checkpoint-every", type=int, default=1,
                    metavar="N",
                    help="campaign mode: durable checkpoint write every "
@@ -572,6 +590,8 @@ def _exec_campaign(args) -> int:
         oom_ladder=oom_ladder,
         checkpoint_every=args.checkpoint_every,
         heartbeat_every=args.heartbeat,
+        pipeline=args.pipeline,
+        solver_workers=args.solver_workers,
     )
 
     def progress(done, total, dt, n_issues):

@@ -22,7 +22,17 @@ streams fixed-shape BATCHES of contracts through ONE compiled program:
   instead of failing, any other failure is retried then BISECTED so
   poison contracts are quarantined individually, and backend loss
   degrades through bounded re-probes to an explicit CPU fallback — a
-  10k campaign loses at most the poison contracts.
+  10k campaign loses at most the poison contracts;
+- with ``pipeline=True`` (the CLI default; docs/performance.md) batch
+  *i*'s HOST phase (detection modules, witness search, report merge)
+  runs on a worker thread while batch *i+1*'s DEVICE phase (corpus
+  packing + sym_run) runs on the main thread, and checkpoint
+  serialization+fsync moves to a background writer — the device never
+  idles waiting for the solver. Results are byte-identical to the
+  serial path (commits stay in batch order; one host phase in flight);
+  ANY fault drains the pipeline back to the serial
+  retry/degrade/bisect machinery above, so PR 1/2 semantics hold
+  unchanged.
 
 CLI: ``python -m mythril_tpu analyze --corpus DIR`` (see interfaces/cli).
 """
@@ -45,7 +55,8 @@ from ..obs import trace as obs_trace
 from ..resilience import (BackendManager, BatchTimeout, DeviceLostError,
                           FaultInjector, classify_backend_error,
                           run_with_watchdog)
-from ..utils.checkpoint import (load_json_checkpoint_resilient,
+from ..utils.checkpoint import (BackgroundCheckpointWriter,
+                                load_json_checkpoint_resilient,
                                 save_json_checkpoint)
 
 # NOTE: no engine imports at module level — ``campaign-merge`` (pure
@@ -172,6 +183,8 @@ class CorpusCampaign:
         oom_ladder: Optional[Sequence[str]] = None,
         checkpoint_every: int = DEFAULT_RESILIENCE.checkpoint_every,
         heartbeat_every: Optional[float] = None,
+        pipeline: bool = False,
+        solver_workers: int = 1,
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -257,6 +270,15 @@ class CorpusCampaign:
         self._backend_emitted = 0   # backend.events already re-emitted
         self._last_ckpt_mono: Optional[float] = None
         self._last_beat: Optional[float] = None
+        # depth-1 batch pipeline (docs/performance.md): overlap batch
+        # i's host phase with batch i+1's device phase; checkpoints go
+        # through a background writer. Off = the PR 1/2 serial path.
+        self.pipeline = bool(pipeline)
+        self.solver_workers = max(1, int(solver_workers))
+        self._ckpt_writer: Optional[BackgroundCheckpointWriter] = None
+        # cumulative overlap accounting for the pipeline_occupancy gauge
+        self._pipe_host_sec = 0.0
+        self._pipe_hidden_sec = 0.0
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -337,30 +359,56 @@ class CorpusCampaign:
                 "shard": [self.num_hosts, self.host_index,
                           len(self.contracts)]}
 
+    @staticmethod
+    def _snapshot_state(state: Dict) -> Dict:
+        """Shallow-copy the mutable containers so the background writer
+        serializes a frozen view while the campaign keeps appending to
+        the live ``res`` lists. One level suffices: list/dict ELEMENTS
+        (issue dicts, event dicts, iprof counts) are append-only — never
+        mutated after they land in the state."""
+        return {k: (list(v) if isinstance(v, list)
+                    else dict(v) if isinstance(v, dict) else v)
+                for k, v in state.items()}
+
     def _save_ckpt(self, state: Dict) -> None:
         p = self._ckpt_path
         if p is None:
             return
         os.makedirs(self.checkpoint_dir, exist_ok=True)
+        if self._ckpt_writer is not None:
+            # pipelined: serialization + fsync move off the commit path.
+            # The durability CONTRACT is unchanged (the writer uses the
+            # same tmp+fsync+rotate+rename writer); only the guarantee's
+            # timing shifts — _last_ckpt_mono is stamped when the rename
+            # actually lands, so the heartbeat's ckpt-age stays honest.
+            def _durable() -> None:
+                self._last_ckpt_mono = time.monotonic()
+
+            self._ckpt_writer.submit(self._snapshot_state(state),
+                                     on_durable=_durable)
+            return
         # checksummed + fsynced + rotated: a crash never corrupts the
         # cursor, and even a torn rename leaves <p>.1 loadable
         save_json_checkpoint(p, state)
         self._last_ckpt_mono = time.monotonic()
 
     # --- one engine pass -----------------------------------------------
-    def _exec_batch(self, bi: int, names: List[str], codes: List[bytes],
-                    lanes: Optional[int] = None,
-                    width: Optional[int] = None) -> Dict:
-        """Analyze one (padded) batch; returns the batch's partial
-        results. This is the unit of work the watchdog guards and the
-        bisection replays on sub-batches — always padded to ``width``
-        (default ``batch_size``) so every attempt at a given rung
-        replays ONE compiled engine. ``lanes``/``width`` below their
-        defaults are the degradation ladder shrinking the working set:
-        a smaller shape is a new (cheaper) compile, and the tighter
-        fork capacity is absorbed by the engine's park/spill machinery
-        (``defer_starved`` + rebalance) instead of dropping paths."""
-        from ..analysis import SymExecWrapper, fire_lasers
+    def _explore_batch(self, bi: int, names: List[str],
+                       codes: List[bytes],
+                       lanes: Optional[int] = None,
+                       width: Optional[int] = None):
+        """DEVICE phase of one batch: pad to the compiled width and run
+        the exploration (SymExecWrapper packs the corpus and drives the
+        ``sym_run`` chunks — the dispatches are async under JAX; only
+        the per-tx harvest syncs ride this thread). Always padded to
+        ``width`` (default ``batch_size``) so every attempt at a given
+        rung replays ONE compiled engine. ``lanes``/``width`` below
+        their defaults are the degradation ladder shrinking the working
+        set: a smaller shape is a new (cheaper) compile, and the
+        tighter fork capacity is absorbed by the engine's park/spill
+        machinery (``defer_starved`` + rebalance) instead of dropping
+        paths. Returns the finished wrapper for :meth:`_harvest_batch`."""
+        from ..analysis import SymExecWrapper
 
         width = self.batch_size if width is None else width
         names = list(names)
@@ -369,7 +417,7 @@ class CorpusCampaign:
         while len(codes) < width:
             names.append(f"_pad_{len(codes)}")
             codes.append(_PAD_BYTECODE)
-        sym = SymExecWrapper(
+        return SymExecWrapper(
             codes, contract_names=names, limits=self.limits,
             spec=self.spec,
             lanes_per_contract=(self.lanes_per_contract
@@ -381,8 +429,20 @@ class CorpusCampaign:
             plugins=self.plugins,
             enable_iprof=self.enable_iprof,
         )
-        report = fire_lasers(sym, white_list=self.modules,
-                             parallel=self.parallel_solving)
+
+    def _harvest_batch(self, bi: int, sym) -> Dict:
+        """HOST phase of one batch: detection modules + witness search +
+        report merge over a finished exploration. Pure host work (the
+        engine arrays were already pulled during the wrapper's per-tx
+        harvest), so the pipelined campaign runs it on a worker thread
+        while the NEXT batch explores on the device."""
+        from ..analysis import fire_lasers
+
+        report = fire_lasers(
+            sym, white_list=self.modules,
+            parallel=self.parallel_solving or self.solver_workers > 1,
+            workers=(self.solver_workers
+                     if self.solver_workers > 1 else None))
         cov = sym.coverage
         issues = []
         for issue in report.issues:
@@ -397,6 +457,16 @@ class CorpusCampaign:
             "dropped": int(cov.get("dropped_forks", 0)),
             "iprof": dict(sym.iprof) if self.enable_iprof else {},
         }
+
+    def _exec_batch(self, bi: int, names: List[str], codes: List[bytes],
+                    lanes: Optional[int] = None,
+                    width: Optional[int] = None) -> Dict:
+        """Analyze one (padded) batch; returns the batch's partial
+        results. Serial composition of the device + host phases — the
+        unit of work the watchdog guards and the bisection replays on
+        sub-batches."""
+        return self._harvest_batch(
+            bi, self._explore_batch(bi, names, codes, lanes, width))
 
     # --- fault isolation ----------------------------------------------
     @staticmethod
@@ -441,6 +511,54 @@ class CorpusCampaign:
 
         return run_with_watchdog(work, self.batch_timeout,
                                  label=f"batch {bi}")
+
+    # --- pipelined phases (docs/performance.md) ------------------------
+    def _device_phase(self, bi: int, items: Sequence[tuple]):
+        """Pipelined attempt, first half: fault-injection check + corpus
+        packing + exploration, under the watchdog (a hung compile
+        surfaces as BatchTimeout instead of stalling BOTH pipeline
+        stages). Returns an opaque handle for :meth:`_host_phase_work`.
+        A custom ``batch_runner`` has no device/host seam — the runner
+        IS the whole attempt, so its finished result rides the handle
+        and the host phase degenerates to a pass-through (same code
+        path, no overlap)."""
+        names = [n for n, _ in items]
+        codes = [c for _, c in items]
+
+        def work():
+            if self.fault_injector is not None:
+                self.fault_injector.fire(batch=bi, contracts=names)
+            if self._batch_runner is not None:
+                if not self._runner_degradable:
+                    return ("out", self._batch_runner(bi, names, codes))
+                return ("out", self._batch_runner(bi, names, codes,
+                                                  lanes=None, width=None))
+            return ("sym", self._explore_batch(bi, names, codes))
+
+        return run_with_watchdog(work, self.batch_timeout,
+                                 label=f"batch {bi} device")
+
+    def _host_phase_work(self, bi: int, handle) -> Dict:
+        """Pipelined attempt, second half: modules + solver + merge,
+        under its own watchdog budget (a wedged witness search must not
+        stall the device side forever)."""
+        kind, payload = handle
+        if kind == "out":
+            return payload
+        return run_with_watchdog(lambda: self._harvest_batch(bi, payload),
+                                 self.batch_timeout,
+                                 label=f"batch {bi} host")
+
+    def _host_phase_job(self, bi: int, handle):
+        """Worker-thread entry: run the host phase inside a span and
+        return ``(out, host_dur, done_mono)`` so the commit side can
+        account overlap (hidden host seconds) and worker idle."""
+        sp = obs_trace.timer("host_phase", bi=bi).start()
+        try:
+            out = self._host_phase_work(bi, handle)
+        finally:
+            sp.stop()
+        return out, sp.dur or 0.0, time.monotonic()
 
     @staticmethod
     def _fault_reason(e: BaseException) -> str:
@@ -506,9 +624,19 @@ class CorpusCampaign:
         raise err
 
     def _run_batch_resilient(self, bi: int,
-                             items: Sequence[tuple]) -> Dict:
+                             items: Sequence[tuple],
+                             first_err: Optional[BaseException] = None
+                             ) -> Dict:
         """Full batch → degrade (OOM) / retry → bisect to the poison
         contract(s).
+
+        ``first_err`` is the pipeline's drain entry: the pipelined
+        device+host attempt already WAS the first attempt (it fired the
+        fault injector exactly once, like a serial first attempt), so
+        on its failure the pipeline hands the error here and this
+        method skips straight to the degrade/retry/bisect tail —
+        attempt counts, events, statuses and quarantine decisions stay
+        byte-identical to a serial run hitting the same fault.
 
         A 10k campaign must lose at most the poison contracts, never the
         run. A failure classified as RESOURCE_EXHAUSTED first walks the
@@ -532,12 +660,18 @@ class CorpusCampaign:
             for k, v in r["iprof"].items():
                 out["iprof"][k] = out["iprof"].get(k, 0) + v
 
-        try:
-            merge(self._guarded_batch(bi, items))
-            return out
-        except Exception as e:  # noqa: BLE001 — isolate, don't die
-            err = e
-            log.warning("batch %d failed (%s)", bi, self._fault_reason(e))
+        if first_err is None:
+            try:
+                merge(self._guarded_batch(bi, items))
+                return out
+            except Exception as e:  # noqa: BLE001 — isolate, don't die
+                err = e
+                log.warning("batch %d failed (%s)", bi,
+                            self._fault_reason(e))
+        else:
+            err = first_err
+            log.warning("batch %d failed pipelined (%s); draining to the "
+                        "serial path", bi, self._fault_reason(err))
         self._note_failure(err)
         kind = classify_backend_error(err)
         if kind == "oom" and self.oom_ladder:
@@ -617,6 +751,162 @@ class CorpusCampaign:
                         ckpt_age=(round(age, 3) if age is not None
                                   else None))
 
+    # --- the pipelined loop --------------------------------------------
+    def _run_pipelined(self, start_batch: int, n_batches: int,
+                       deadline: Optional[float], commit) -> None:
+        """Depth-1 batch pipeline: batch *i*'s host phase (worker
+        thread) overlaps batch *i+1*'s device phase (this thread).
+
+        Invariants that keep results byte-identical to the serial loop:
+
+        - at most ONE host phase is in flight, and ``commit`` runs
+          strictly in batch order (batch *i* commits before *i+1*'s
+          host phase is even submitted);
+        - the fault injector fires once per pipelined attempt, in the
+          device phase — the same cadence as a serial first attempt;
+        - ANY phase failure drains: the outstanding host phase commits
+          first, then the failed batch re-enters
+          ``_run_batch_resilient`` with ``first_err`` set, so degrade/
+          retry/bisect/quarantine decisions replay the serial machinery
+          exactly (``ok-degraded:<rung>``, retry counts, statuses);
+        - an ``InjectedKill`` (or real signal) blows through
+          uncommitted, exactly like the serial loop — the resume path
+          replays what was never durably recorded, nothing twice.
+
+        Stall telemetry (docs/performance.md): ``pipeline_stall`` spans
+        with ``wait=device-waits-host`` (this loop blocked on an
+        unfinished host phase — the device sat idle) and
+        ``wait=host-waits-device`` (the worker sat idle between host
+        phases; the attr is ``wait``, not ``kind`` — ``kind`` is the
+        JSONL schema's reserved record-type field and a colliding span
+        attr is dropped), plus a ``pipeline_occupancy`` gauge = fraction of
+        host-phase seconds hidden behind device execution. The per-batch
+        ``batch`` span/wall is ``device_dur + commit_stall`` — the
+        batch's contribution to campaign wall-clock — so the trace
+        report's batch stall table sums to (about) the campaign wall,
+        and a pipelined run's total reads strictly below a serial run's
+        whenever any host time was hidden."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        reg = obs_metrics.REGISTRY
+        pool = ThreadPoolExecutor(max_workers=1,
+                                  thread_name_prefix="host-phase")
+        inflight: Optional[Dict] = None
+        host_idle_since: Optional[float] = None
+
+        def account_overlap(host_dur: float, stall: float) -> None:
+            hidden = max(0.0, host_dur - stall)
+            self._pipe_host_sec += host_dur
+            self._pipe_hidden_sec += hidden
+            reg.counter(
+                "pipeline_host_hidden_seconds_total",
+                help="host-phase seconds overlapped with device "
+                     "execution").inc(hidden)
+            reg.gauge(
+                "pipeline_occupancy",
+                help="fraction of host-phase seconds hidden behind "
+                     "device execution").set(
+                self._pipe_hidden_sec / self._pipe_host_sec
+                if self._pipe_host_sec else 0.0)
+
+        def drain_serial(bi: int, items: Sequence[tuple], err,
+                         dev_dur: float, t_wall: float, t_mono: float,
+                         stall: float = 0.0) -> None:
+            """Pipelined attempt failed: replay the serial machinery
+            (skipping the already-paid first attempt) and commit."""
+            rec = obs_trace.timer("batch_drain", bi=bi).start()
+            out = self._run_batch_resilient(bi, items, first_err=err)
+            rec.stop()
+            dt = dev_dur + stall + (rec.dur or 0.0)
+            obs_trace.complete("batch", dt, t_wall=t_wall, mono=t_mono,
+                               bi=bi, n=len(items), pipelined=True,
+                               drained=True)
+            commit(bi, out, dt)
+
+        def commit_inflight(fl: Dict) -> None:
+            nonlocal host_idle_since
+            bi = fl["bi"]
+            wait_sp = obs_trace.timer("pipeline_stall",
+                                      wait="device-waits-host",
+                                      bi=bi).start()
+            try:
+                out, host_dur, done_mono = fl["future"].result()
+            except Exception as e:  # noqa: BLE001 — drain to serial
+                wait_sp.stop()
+                host_idle_since = time.monotonic()
+                drain_serial(bi, fl["items"], e, fl["dev_dur"],
+                             fl["t_wall"], fl["mono"],
+                             stall=wait_sp.dur or 0.0)
+                return
+            stall = wait_sp.stop()
+            host_idle_since = done_mono
+            # a clean pipelined attempt is a clean first attempt: same
+            # resilience envelope _run_batch_resilient gives its own
+            # first-try success (no retries, nothing quarantined)
+            out = {"issues": out["issues"], "paths": out["paths"],
+                   "dropped": out["dropped"], "iprof": out["iprof"],
+                   "quarantined": [], "retries": 0, "status": "ok"}
+            reg.counter(
+                "pipeline_device_waits_host_seconds_total",
+                help="device idle: loop blocked on an unfinished host "
+                     "phase").inc(stall)
+            account_overlap(host_dur, stall)
+            dt = fl["dev_dur"] + stall
+            obs_trace.complete("batch", dt, t_wall=fl["t_wall"],
+                               mono=fl["mono"], bi=bi, n=fl["n"],
+                               pipelined=True,
+                               device_dur=round(fl["dev_dur"], 6),
+                               host_dur=round(host_dur, 6),
+                               stall=round(stall, 6))
+            commit(bi, out, dt)
+
+        try:
+            for bi in range(start_batch, n_batches):
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                items = self.contracts[
+                    bi * self.batch_size:(bi + 1) * self.batch_size]
+                t_wall, t_mono = time.time(), time.monotonic()
+                dev_sp = obs_trace.timer("device_phase", bi=bi,
+                                         n=len(items)).start()
+                handle = None
+                first_err: Optional[BaseException] = None
+                try:
+                    handle = self._device_phase(bi, items)
+                except Exception as e:  # noqa: BLE001 — drained below
+                    first_err = e
+                dev_dur = dev_sp.stop()
+                # commit the PREVIOUS batch only now: its host phase ran
+                # concurrently with the device phase that just finished
+                if inflight is not None:
+                    commit_inflight(inflight)
+                    inflight = None
+                if first_err is not None:
+                    drain_serial(bi, items, first_err, dev_dur,
+                                 t_wall, t_mono)
+                    continue
+                now = time.monotonic()
+                if host_idle_since is not None:
+                    idle = max(0.0, now - host_idle_since)
+                    obs_trace.complete("pipeline_stall", idle,
+                                       wait="host-waits-device", bi=bi)
+                    reg.counter(
+                        "pipeline_host_waits_device_seconds_total",
+                        help="worker idle between host phases").inc(idle)
+                inflight = {"bi": bi, "items": items, "n": len(items),
+                            "dev_dur": dev_dur, "t_wall": t_wall,
+                            "mono": t_mono,
+                            "future": pool.submit(self._host_phase_job,
+                                                  bi, handle)}
+            if inflight is not None:
+                commit_inflight(inflight)
+                inflight = None
+        finally:
+            # no blocking wait: on the kill path a future may still be
+            # running its (now-moot) host phase; the worker finishes
+            # harmlessly and the pool reaps it
+            pool.shutdown(wait=False)
+
     # --- the campaign --------------------------------------------------
     def run(self, progress=None) -> CampaignResult:
         from ..smt.solver import SOLVER_STATS
@@ -655,19 +945,19 @@ class CorpusCampaign:
                     + list(self._events))
 
         n_batches = (len(self.contracts) + self.batch_size - 1) // self.batch_size
-        dirty = False
+        dirty = [False]  # mutable: commit() below flips it
         start_batch = int(state["next_batch"])
-        for bi in range(start_batch, n_batches):
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            batch = self.contracts[bi * self.batch_size:(bi + 1) * self.batch_size]
-            with obs_trace.timer("batch", bi=bi, n=len(batch)) as sp:
-                out = self._run_batch_resilient(bi, batch)
-            dt = sp.elapsed
+        reg = obs_metrics.REGISTRY
+
+        def commit(bi: int, out: Dict, dt: float) -> None:
+            """Merge one finished batch into the result + checkpoint
+            state. BOTH loops (serial below, pipelined) call this
+            strictly in batch order — it is the single accounting
+            point, which is what makes a pipelined run's results
+            byte-identical to a serial run's."""
             self._emit_backend_events()
             obs_trace.event("batch_status", bi=bi, status=out["status"],
                             dur=round(dt, 6))
-            reg = obs_metrics.REGISTRY
             reg.counter("batches_total").inc()
             reg.histogram("batch_seconds",
                           help="per-batch wall time").observe(dt)
@@ -683,6 +973,9 @@ class CorpusCampaign:
             res.quarantined.extend(out["quarantined"])
             res.retries += out["retries"]
             res.batch_status.append(out["status"])
+            # safe to read here even in pipelined mode: solver queries
+            # only run in host phases, which are committed in order and
+            # never concurrently with this call
             sess = SOLVER_STATS.delta(stats_at_start)
             state.update(next_batch=bi + 1, issues=res.issues,
                          batch_wall=res.batch_wall,
@@ -702,9 +995,9 @@ class CorpusCampaign:
             if (bi + 1 - start_batch) % self.checkpoint_every == 0 \
                     or bi + 1 == n_batches:
                 self._save_ckpt(state)
-                dirty = False
+                dirty[0] = False
             else:
-                dirty = True
+                dirty[0] = True
             # solver gauges mirror the accumulated campaign totals —
             # a scrape mid-run sees the whole-campaign split, like the
             # final report will
@@ -719,10 +1012,46 @@ class CorpusCampaign:
                         or now - self._last_beat >= self.heartbeat_every):
                     self._last_beat = now
                     self._heartbeat(bi + 1, n_batches, res, out)
-        if dirty:
-            # deadline (or loop-exit) with unpersisted batches: flush so
-            # the paid work survives the session
-            self._save_ckpt(state)
+
+        self._ckpt_writer = None
+        if self.pipeline and self._ckpt_path is not None:
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            self._ckpt_writer = BackgroundCheckpointWriter(self._ckpt_path)
+        try:
+            if self.pipeline:
+                self._run_pipelined(start_batch, n_batches, deadline,
+                                    commit)
+            else:
+                for bi in range(start_batch, n_batches):
+                    if (deadline is not None
+                            and time.monotonic() >= deadline):
+                        break
+                    batch = self.contracts[
+                        bi * self.batch_size:(bi + 1) * self.batch_size]
+                    with obs_trace.timer("batch", bi=bi,
+                                         n=len(batch)) as sp:
+                        out = self._run_batch_resilient(bi, batch)
+                    commit(bi, out, sp.elapsed)
+            if dirty[0]:
+                # deadline (or loop-exit) with unpersisted batches:
+                # flush so the paid work survives the session
+                self._save_ckpt(state)
+            if self._ckpt_writer is not None:
+                # the last submitted snapshot must be durable before the
+                # result is reported — close() flushes, then joins
+                self._ckpt_writer.close()
+                self._ckpt_writer = None
+        except BaseException:
+            # a (simulated) kill or unhandled fault must NOT flush the
+            # queued checkpoint snapshot: a real SIGKILL would not have,
+            # and the kill/resume no-double-count guard is tested
+            # against exactly that contract. An already-started write
+            # completes (or tears — the loaders' checksum + rotation
+            # fallback covers both).
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.close(discard_pending=True)
+                self._ckpt_writer = None
+            raise
 
         res.batches = len(res.batch_wall)
         res.contracts = min(res.batches * self.batch_size, len(self.contracts))

@@ -285,6 +285,24 @@ def event(kind: str, **attrs) -> None:
         t.event(kind, **attrs)
 
 
+def complete(name: str, dur: float, t_wall: Optional[float] = None,
+             mono: Optional[float] = None, **attrs) -> None:
+    """Emit an already-measured span. For durations assembled from
+    overlapping phases (the campaign pipeline's per-batch wall is
+    ``device_dur + commit_stall``, which no single ``with`` block
+    brackets) a caller computes the value and records it here. No-op
+    when tracing is off; ``t_wall``/``mono`` default to "ended just
+    now" so the span lands at the right place on the timeline."""
+    t = _TRACER
+    if t is None:
+        return
+    sp = Span(None, name, attrs)
+    sp.dur = max(0.0, float(dur))
+    sp.t_wall = time.time() - sp.dur if t_wall is None else t_wall
+    sp._t0 = time.monotonic() - sp.dur if mono is None else mono
+    t._emit_span(sp)
+
+
 def close() -> None:
     """Close and uninstall the global tracer (writes the Chrome file)."""
     global _TRACER
@@ -293,5 +311,6 @@ def close() -> None:
         _TRACER = None
 
 
-__all__ = ["SCHEMA", "Span", "Tracer", "active", "close", "configure",
-           "event", "get_tracer", "jsonl_path_for", "span", "timer"]
+__all__ = ["SCHEMA", "Span", "Tracer", "active", "close", "complete",
+           "configure", "event", "get_tracer", "jsonl_path_for", "span",
+           "timer"]

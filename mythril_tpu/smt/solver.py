@@ -13,12 +13,15 @@ degrade-to-no-issue semantics as the reference's solver timeout
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import metrics as obs_metrics
 from ..symbolic.ops import SymOp, FreeKind
 from .eval import Assignment, M256, evaluate
 from .tape import HostTape
@@ -406,10 +409,46 @@ def _mutate_leaf(tape: HostTape, leaf: int, asn: Assignment, rng: random.Random)
 
 #: memoized solve front door (reference: ``support/model.py get_model``'s
 #: lru cache ⚠unv, SURVEY §2 "Model cache"). Key = full structural
-#: fingerprint + search budget; capped FIFO so corpus runs can't grow it
-#: unboundedly. Caching `unknown` is safe because the budget is in the key.
-_SOLVE_CACHE: Dict[tuple, Tuple[str, Optional[Assignment]]] = {}
-_SOLVE_CACHE_CAP = 8192
+#: fingerprint + search budget; a TRUE LRU (hits refresh recency) capped
+#: at ``_SOLVE_CACHE_CAP`` so a 10k-contract campaign — whose dispatcher
+#: queries recur heavily within a batch but churn across the corpus —
+#: keeps the hot working set without growing without bound. Caching
+#: `unknown` is safe because the budget is in the key. The cap is
+#: configurable via :func:`set_solve_cache_cap` or the
+#: ``MYTHRIL_SOLVE_CACHE_CAP`` env var (0 disables caching); size and
+#: eviction totals are published as ``solver_cache_size`` /
+#: ``solver_cache_evictions_total`` in the metrics registry.
+_SOLVE_CACHE: "OrderedDict[tuple, Tuple[str, Optional[Assignment]]]" = \
+    OrderedDict()
+_SOLVE_CACHE_CAP = int(os.environ.get("MYTHRIL_SOLVE_CACHE_CAP", "") or 8192)
+_SOLVE_CACHE_LOCK = threading.Lock()
+
+
+def set_solve_cache_cap(cap: int) -> int:
+    """Set the solve-cache entry cap (evicting down immediately);
+    returns the previous cap. 0 disables memoization."""
+    global _SOLVE_CACHE_CAP
+    prev = _SOLVE_CACHE_CAP
+    _SOLVE_CACHE_CAP = max(0, int(cap))
+    with _SOLVE_CACHE_LOCK:
+        _cache_evict_locked()
+    return prev
+
+
+def _cache_evict_locked() -> None:
+    """Evict oldest entries down to the cap; callers hold the lock.
+    Publishes the size gauge + eviction counter on every mutation."""
+    evicted = 0
+    while len(_SOLVE_CACHE) > _SOLVE_CACHE_CAP:
+        _SOLVE_CACHE.popitem(last=False)
+        evicted += 1
+    if evicted:
+        obs_metrics.REGISTRY.counter(
+            "solver_cache_evictions_total",
+            help="LRU evictions from the solve memo cache").inc(evicted)
+    obs_metrics.REGISTRY.gauge(
+        "solver_cache_size",
+        help="entries in the solve memo cache").set(len(_SOLVE_CACHE))
 
 
 def _fingerprint(tape: HostTape, seed: int, max_iters: int,
@@ -440,9 +479,15 @@ def solve_tape_ex(tape: HostTape, seed: int = 0, max_iters: int = 400,
     t0 = time.perf_counter()
     deadline = None if max_time is None else t0 + max_time
     key = None
-    if base is None:
+    if base is None and _SOLVE_CACHE_CAP > 0:
         key = _fingerprint(tape, seed, max_iters, max_time)
-        hit = _SOLVE_CACHE.get(key)
+        with _SOLVE_CACHE_LOCK:
+            hit = _SOLVE_CACHE.get(key)
+            if hit is not None:
+                # a hit is a *use*: refresh recency so the corpus's hot
+                # recurring queries (dispatcher/require structure) stay
+                # resident while one-off fingerprints age out
+                _SOLVE_CACHE.move_to_end(key)
         if hit is not None:
             verdict, asn = hit
             SOLVER_STATS.record(verdict, time.perf_counter() - t0,
@@ -463,16 +508,14 @@ def solve_tape_ex(tape: HostTape, seed: int = 0, max_iters: int = 400,
         # for re-queries issued after contention subsides
         key = None
     if key is not None:
-        if len(_SOLVE_CACHE) >= _SOLVE_CACHE_CAP:
-            # tolerant eviction: under --parallel-solving two module
-            # threads can race the read-then-pop; losing the race must
-            # not throw (a raised KeyError here would eat the caller
-            # module's whole finding list)
-            try:
-                _SOLVE_CACHE.pop(next(iter(_SOLVE_CACHE)), None)
-            except (StopIteration, RuntimeError):
-                pass
-        _SOLVE_CACHE[key] = (verdict, out.copy() if out is not None else None)
+        # lock, not tolerant-race: --parallel-solving module threads and
+        # the campaign's pipelined host phase both insert concurrently,
+        # and an OrderedDict's relink is not atomic under mutation
+        with _SOLVE_CACHE_LOCK:
+            _SOLVE_CACHE[key] = (verdict,
+                                 out.copy() if out is not None else None)
+            _SOLVE_CACHE.move_to_end(key)
+            _cache_evict_locked()
     SOLVER_STATS.record(verdict, time.perf_counter() - t0)
     return verdict, out
 

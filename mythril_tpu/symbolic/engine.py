@@ -2713,7 +2713,8 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
     return _note_backjump(new, back_copy, b.pc - 1, new.fork_dest, loop_bound)
 
 
-def rebalance_parked(sf: SymFrontier, fork_block: int = 0):
+def rebalance_parked(sf: SymFrontier, fork_block: int = 0,
+                     active=None, fork_req=None):
     """Move persistently starved fork-requesting lanes into other blocks'
     free slots. Host-planned at the chunk seam, device-applied as one
     gather/scatter per leaf — the jitted superstep loop stays shard-local
@@ -2724,16 +2725,26 @@ def rebalance_parked(sf: SymFrontier, fork_block: int = 0):
     ``expand_forks`` with ``defer_starved``) whose own block has no free
     slot is RELOCATED to the block with the most free slots (needs >= 2:
     one for the lane, one for the fork it will re-raise); its old slot
-    frees up for its neighbors. Returns ``(sf, n_moved)``."""
+    frees up for its neighbors. Returns ``(sf, n_moved)``.
+
+    ``active``/``fork_req`` accept host copies of those leaves a caller
+    already transferred this chunk boundary (SymExecWrapper shares ONE
+    fetch between this planner, the drain check, and the telemetry
+    gauges) — each is a device→host sync, and paying it twice per chunk
+    was measurable on the device path."""
     import numpy as np
 
-    parked = np.asarray(sf.fork_req) & np.asarray(sf.base.active)
+    if active is None:
+        active = np.asarray(sf.base.active)
+    if fork_req is None:
+        fork_req = np.asarray(sf.fork_req)
+    parked = np.asarray(fork_req) & np.asarray(active)
     if not parked.any():
         return sf, 0
     P = parked.shape[0]
     B = fork_block if fork_block > 0 else P
     G = P // B
-    free = ~np.asarray(sf.base.active)
+    free = ~np.asarray(active)
     free_cnt = free.reshape(G, B).sum(axis=1)
     free_lists = [list(np.where(free.reshape(G, B)[g])[0] + g * B)
                   for g in range(G)]

@@ -31,6 +31,7 @@ import io
 import json
 import logging
 import os
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -359,6 +360,104 @@ def _load_json_checkpoint_inner(path: str) -> Dict:
     return state
 
 
+class BackgroundCheckpointWriter:
+    """Serialize + durably write campaign JSON checkpoints off the
+    critical path (the pipelined campaign's host phase must not stall
+    on fsync — docs/performance.md).
+
+    One worker thread; submissions COALESCE (latest state wins). That is
+    safe because every submitted state is a complete, self-contained
+    snapshot: skipping an intermediate one only widens the replay window
+    after a crash, it never breaks consistency. Each write goes through
+    :func:`save_json_checkpoint` — the identical v2
+    tmp+fsync+rotate+atomic-rename contract as the synchronous path, so
+    a kill at ANY instant (including mid-background-write) still leaves
+    either the previous durable file or its rotated ``.1`` loadable.
+
+    A write failure is remembered and re-raised at the next ``submit``
+    or ``flush``/``close`` — a campaign must not silently run on without
+    durability. The thread is a daemon: an abrupt interpreter death
+    behaves exactly like kill -9 mid-write, which the loaders' checksum
+    + rotation fallback already covers.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._cond = threading.Condition()
+        self._pending: Optional[Tuple[Dict, Optional[Any]]] = None
+        self._writing = False
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"ckpt-writer:{os.path.basename(path)}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stop:
+                    self._cond.wait()
+                if self._pending is None:
+                    return  # stopped with nothing left to write
+                state, on_durable = self._pending
+                self._pending = None
+                self._writing = True
+            try:
+                save_json_checkpoint(self.path, state)
+                if on_durable is not None:
+                    on_durable()
+            except Exception as e:  # noqa: BLE001 — surfaced at submit
+                with self._cond:
+                    self._error = e
+            finally:
+                with self._cond:
+                    self._writing = False
+                    self._cond.notify_all()
+
+    def _raise_pending_error_locked(self) -> None:
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+
+    def submit(self, state: Dict,
+               on_durable: Optional[Any] = None) -> None:
+        """Queue ``state`` for a durable write (replacing any not-yet-
+        started queued state). ``on_durable`` (zero-arg callable) runs in
+        the writer thread after the rename lands. The caller must not
+        mutate ``state`` afterwards — pass a snapshot."""
+        with self._cond:
+            self._raise_pending_error_locked()
+            if self._stop:
+                raise RuntimeError(f"checkpoint writer for {self.path} "
+                                   "is closed")
+            self._pending = (state, on_durable)
+            self._cond.notify_all()
+
+    def flush(self) -> None:
+        """Block until everything submitted so far is durably on disk."""
+        with self._cond:
+            while self._pending is not None or self._writing:
+                self._cond.wait()
+            self._raise_pending_error_locked()
+
+    def close(self, discard_pending: bool = False) -> None:
+        """Stop the writer. By default the queued state (if any) is
+        written first; ``discard_pending`` drops it — the simulated-kill
+        path, where flushing would grant durability a real SIGKILL never
+        would. An in-flight write always completes (it cannot be
+        interrupted, same as a real kill racing the rename)."""
+        with self._cond:
+            if discard_pending:
+                self._pending = None
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=60.0)
+        if not discard_pending:
+            with self._cond:
+                self._raise_pending_error_locked()
+
+
 def load_json_checkpoint_resilient(
         path: str) -> Tuple[Optional[Dict], Optional[str]]:
     """``(state, source_path)`` trying ``path`` then ``<path>.1``.
@@ -392,8 +491,8 @@ def load_json_checkpoint_resilient(
 
 
 __all__ = [
-    "CHECKPOINT_SCHEMA", "CheckpointCorrupt", "ROTATE_SUFFIX",
-    "load_frontier", "load_frontier_resilient", "load_json_checkpoint",
-    "load_json_checkpoint_resilient", "save_frontier",
-    "save_json_checkpoint",
+    "BackgroundCheckpointWriter", "CHECKPOINT_SCHEMA", "CheckpointCorrupt",
+    "ROTATE_SUFFIX", "load_frontier", "load_frontier_resilient",
+    "load_json_checkpoint", "load_json_checkpoint_resilient",
+    "save_frontier", "save_json_checkpoint",
 ]

@@ -1,0 +1,224 @@
+"""Pipelined campaign (docs/performance.md): batch i's host phase
+overlaps batch i+1's device phase, checkpoints move to a background
+writer — and NONE of it may change results. The contract under test:
+
+- pipelined == serial, byte-for-byte, on issues / paths / iprof /
+  quarantine / batch_status (the acceptance bar for the overlap layer);
+- any fault drains the pipeline back to the serial retry/bisect
+  machinery with identical outcomes;
+- kill+resume still never double-counts a contract, even though the
+  durability point moved onto the writer thread.
+"""
+
+import os
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.config import TEST_LIMITS
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.mythril.campaign import CorpusCampaign, load_corpus_dir
+from mythril_tpu.resilience import FaultInjector, InjectedKill
+from mythril_tpu.utils.checkpoint import (BackgroundCheckpointWriter,
+                                          ROTATE_SUFFIX,
+                                          load_json_checkpoint)
+
+KILLABLE = assemble(0, "SELFDESTRUCT")
+SAFE = assemble(1, 0, "SSTORE", "STOP")
+
+
+def write_corpus(tmp_path, n=6):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    for i in range(n):
+        code = KILLABLE if i % 2 == 0 else SAFE
+        (d / f"c{i:03d}.hex").write_text(code.hex())
+    return str(d)
+
+
+def make_campaign(corpus_dir, ckpt=None, fault=None, **kw):
+    return CorpusCampaign(
+        load_corpus_dir(corpus_dir),
+        batch_size=4, lanes_per_contract=8, limits=TEST_LIMITS,
+        max_steps=64, transaction_count=1,
+        modules=["AccidentallyKillable"], checkpoint_dir=ckpt,
+        fault_injector=FaultInjector.from_string(fault), **kw)
+
+
+def _sig(res):
+    """Everything the acceptance criteria require to be identical
+    between a pipelined and a serial run (timings excluded — those
+    are the point of the pipeline)."""
+    return {
+        "issues": sorted((i["contract"], i["swc-id"], i["batch"])
+                         for i in res.issues),
+        "paths_total": res.paths_total,
+        "dropped_forks": res.dropped_forks,
+        "iprof": res.iprof,
+        "quarantined": [q["name"] for q in res.quarantined],
+        "batch_status": res.batch_status,
+        "retries": res.retries,
+    }
+
+
+def test_pipelined_matches_serial(tmp_path):
+    corpus = write_corpus(tmp_path)
+    serial = make_campaign(corpus, pipeline=False).run()
+    piped = make_campaign(corpus, pipeline=True).run()
+    assert _sig(piped) == _sig(serial)
+    assert piped.batches == serial.batches == 2
+    # sanity on the shared fixture: the three killable contracts
+    assert _sig(piped)["issues"] and _sig(piped)["quarantined"] == []
+
+
+def test_pipelined_drains_to_serial_on_fault(tmp_path):
+    """A poison contract inside a pipelined batch must produce the
+    EXACT serial outcome: drain, retry once, bisect, quarantine the
+    poison — statuses, retries and the quarantine set all equal."""
+    corpus = write_corpus(tmp_path)
+    serial = make_campaign(corpus, fault="raise:contract=c002",
+                           pipeline=False).run()
+    piped = make_campaign(corpus, fault="raise:contract=c002",
+                          pipeline=True).run()
+    assert _sig(piped) == _sig(serial)
+    assert [q["name"] for q in piped.quarantined] == ["c002"]
+    assert piped.batch_status[0].startswith("quarantined:")
+
+
+def test_pipelined_transient_fault_retries_once(tmp_path):
+    """times=1 transient fault: the pipelined first attempt counts as
+    THE first attempt (injector fires once in the device phase), so
+    the retry-once policy cures it with retries == 1, like serial."""
+    corpus = write_corpus(tmp_path)
+    piped = make_campaign(corpus, fault="raise:batch=0:times=1",
+                          pipeline=True).run()
+    assert piped.retries == 1
+    assert piped.batch_status == ["ok-retry", "ok"]
+    assert not piped.quarantined
+    assert sorted({i["contract"] for i in piped.issues}) == \
+        ["c000", "c002", "c004"]
+
+
+def test_pipelined_kill_resume_no_double_count(tmp_path):
+    """InjectedKill mid-pipeline blows through uncommitted (the
+    background writer must NOT flush on the way down); the resumed
+    pipelined run replays only undurable batches and counts every
+    contract exactly once."""
+    corpus = write_corpus(tmp_path)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(InjectedKill):
+        make_campaign(corpus, ckpt=ck, fault="kill:batch=1",
+                      pipeline=True).run()
+    resumed = make_campaign(corpus, ckpt=ck, pipeline=True).run()
+    assert resumed.batches == 2
+    assert sorted(i["contract"] for i in resumed.issues) == \
+        ["c000", "c002", "c004"]
+    assert len(resumed.issues) == 3  # nothing double-counted
+    state = load_json_checkpoint(os.path.join(ck, "campaign.json"))
+    assert state["next_batch"] == 2
+
+
+def test_pipeline_emits_overlap_telemetry(tmp_path):
+    """The obs spine must carry the pipeline story: device/host phase
+    spans, pipeline_stall spans, a pipeline_occupancy gauge, and the
+    trace-report overlap summary must render it."""
+    import importlib.util
+    import json
+
+    from mythril_tpu.obs import metrics as obs_metrics
+    from mythril_tpu.obs import trace as obs_trace
+
+    corpus = write_corpus(tmp_path)
+    tpath = str(tmp_path / "t.json")
+    obs_trace.configure(tpath)
+    try:
+        make_campaign(corpus, pipeline=True).run()
+    finally:
+        obs_trace.close()
+    names = set()
+    with open(str(tmp_path / "t.jsonl")) as fh:
+        for line in fh:
+            e = json.loads(line)
+            if e.get("kind") == "span":
+                names.add(e["name"])
+    assert {"device_phase", "host_phase", "pipeline_stall",
+            "batch"} <= names
+    gauges = obs_metrics.REGISTRY.snapshot()["gauges"]
+    assert "pipeline_occupancy" in gauges
+    assert 0.0 <= gauges["pipeline_occupancy"] <= 1.0
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(root, "tools", "trace_report.py"))
+    tr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+    spans, instants = tr.load_trace(str(tmp_path / "t.jsonl"))
+    text = tr.report(spans, instants)
+    assert "pipeline overlap" in text
+    assert "host time hidden behind device execution" in text
+
+
+def test_pipeline_with_stub_runner_falls_through(tmp_path):
+    """A custom batch_runner has no device/host seam: the handle
+    carries its finished result and the pipeline degenerates to the
+    serial order (runner called once per batch, in order)."""
+    calls = []
+
+    def runner(bi, names, codes, lanes=None, width=None):
+        calls.append(bi)
+        return {"issues": [], "paths": len(names), "dropped": 0,
+                "iprof": {}}
+
+    c = CorpusCampaign([(f"c{i:03d}", b"\x00") for i in range(8)],
+                       batch_size=2, batch_runner=runner, pipeline=True,
+                       fault_injector=None)
+    r = c.run()
+    assert calls == [0, 1, 2, 3]
+    assert r.batches == 4 and r.paths_total == 8
+    assert r.batch_status == ["ok"] * 4
+
+
+# --- the background checkpoint writer ---------------------------------
+
+def test_background_writer_durable_and_rotating(tmp_path):
+    p = str(tmp_path / "campaign.json")
+    w = BackgroundCheckpointWriter(p)
+    w.submit({"next_batch": 1})
+    w.flush()
+    assert load_json_checkpoint(p)["next_batch"] == 1
+    w.submit({"next_batch": 2})
+    w.close()  # close flushes the queued write
+    assert load_json_checkpoint(p)["next_batch"] == 2
+    # the v2 rotation contract survived the move off-thread
+    assert os.path.exists(p + ROTATE_SUFFIX)
+    assert load_json_checkpoint(p + ROTATE_SUFFIX)["next_batch"] == 1
+    with pytest.raises(RuntimeError):
+        w.submit({"next_batch": 3})  # closed writer refuses work
+
+
+def test_background_writer_coalesces_to_latest(tmp_path):
+    p = str(tmp_path / "c.json")
+    w = BackgroundCheckpointWriter(p)
+    for i in range(50):  # submissions outpace fsync: latest must win
+        w.submit({"next_batch": i})
+    w.flush()
+    w.close()
+    assert load_json_checkpoint(p)["next_batch"] == 49
+
+
+def test_background_writer_discard_pending(tmp_path):
+    """close(discard_pending=True) is the simulated-kill path: a queued
+    snapshot must NOT gain durability a real SIGKILL would deny it."""
+    p = str(tmp_path / "c.json")
+    w = BackgroundCheckpointWriter(p)
+    w.submit({"next_batch": 1})
+    w.flush()
+    w.submit({"next_batch": 2})
+    w.close(discard_pending=True)
+    # the queued write may or may not have STARTED before close; either
+    # way the on-disk state is one of the two consistent snapshots
+    assert load_json_checkpoint(p)["next_batch"] in (1, 2)
+
+    w2 = BackgroundCheckpointWriter(p + "x")
+    w2.close(discard_pending=True)  # close with nothing queued is clean
+    assert not os.path.exists(p + "x")

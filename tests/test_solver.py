@@ -305,3 +305,52 @@ def test_partition_stats_and_erc20_path_still_solves():
     assert bool(out.halted[0]) and not bool(out.error[0])
     d = SOLVER_STATS.delta(before)
     assert d["sat"] >= 1
+
+
+# --- round-6 bounded LRU solve cache (perf_opt PR: 10k-corpus runs) ---
+
+def test_solve_cache_lru_bounded_with_metrics():
+    """The memo cache is a true LRU with a configurable cap: hits
+    refresh recency, inserts past the cap evict the OLDEST entry, and
+    size/evictions are published to the metrics registry."""
+    from mythril_tpu.obs import metrics as obs_metrics
+    from mythril_tpu.smt.solver import (SOLVER_STATS, _SOLVE_CACHE,
+                                        set_solve_cache_cap, solve_tape)
+    from mythril_tpu.smt.tape import HostNode
+    from mythril_tpu.symbolic.ops import SymOp, FreeKind
+    N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)
+
+    def tape(v):
+        nodes = [
+            N(SymOp.NULL),
+            N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),
+            N(SymOp.CONST, imm=v),
+            N(SymOp.EQ, 1, 2),
+        ]
+        return _mk_tape(nodes, [(3, True)])
+
+    _SOLVE_CACHE.clear()
+    prev = set_solve_cache_cap(4)
+    ev = obs_metrics.REGISTRY.counter("solver_cache_evictions_total")
+    ev0 = ev.value
+    try:
+        assert solve_tape(tape(0x1234)) is not None   # entry A
+        for v in range(1, 4):
+            solve_tape(tape(v))                       # fill to the cap
+        assert len(_SOLVE_CACHE) == 4
+        solve_tape(tape(0x1234))                      # HIT: refresh A
+        solve_tape(tape(999))                         # evicts v=1, not A
+        assert len(_SOLVE_CACHE) == 4
+        assert ev.value - ev0 == 1
+        assert obs_metrics.REGISTRY.gauge(
+            "solver_cache_size").value == 4
+        before = SOLVER_STATS.snapshot()
+        solve_tape(tape(0x1234))                      # A survived the LRU
+        assert SOLVER_STATS.delta(before)["cache_hits"] == 1
+        # shrinking the cap evicts down immediately
+        set_solve_cache_cap(2)
+        assert len(_SOLVE_CACHE) == 2
+        assert ev.value - ev0 == 3
+    finally:
+        set_solve_cache_cap(prev)
+        _SOLVE_CACHE.clear()

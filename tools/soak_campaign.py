@@ -26,7 +26,14 @@ Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
      (``kind``, ``t``, ``schema``) on EVERY event, the Chrome trace
      must be valid JSON with superstep/batch/checkpoint spans and
      degrade events, and the metrics snapshot must carry the campaign
-     counters.
+     counters;
+  7. pipeline — the depth-1 pipelined campaign (docs/performance.md)
+     killed mid-pipeline while the BACKGROUND checkpoint writer owns
+     durability, the newest checkpoint then torn mid-file (a kill -9
+     landing during the background write); the pipelined resume must
+     detect the tear, replay only undurable batches, converge to the
+     same issue set with no contract counted twice, and leave a newest
+     checkpoint that loads cleanly.
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
@@ -83,7 +90,8 @@ KILLABLE = assemble(0, "SELFDESTRUCT")
 SAFE = assemble(1, 0, "SSTORE", "STOP")
 N = 6  # even indices killable -> expected issues c000/c002/c004
 
-LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry")
+LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry",
+        "pipeline")
 
 
 def write_corpus(d: str) -> str:
@@ -97,9 +105,10 @@ def write_corpus(d: str) -> str:
 
 
 def campaign(corpus: str, ckpt: str, fault: str | None, **kw):
+    kw.setdefault("batch_size", 4)
     return CorpusCampaign(
         load_corpus_dir(corpus),
-        batch_size=4, lanes_per_contract=8, limits=TEST_LIMITS,
+        lanes_per_contract=8, limits=TEST_LIMITS,
         max_steps=64, transaction_count=1,
         modules=["AccidentallyKillable"], checkpoint_dir=ckpt,
         batch_timeout=_BATCH_TIMEOUT,  # guards the soak, not the test
@@ -268,6 +277,51 @@ def main() -> int:
                    and not r6.quarantined
                    and sorted(i["contract"] for i in r6.issues)
                    == ["c000", "c002", "c004"])
+
+        if "pipeline" in want:
+            # leg 7: pipelined campaign + background checkpoint writer
+            # under kill + torn-write. batch_size=2 -> 3 batches; the
+            # kill fires in batch 2's DEVICE phase, i.e. while batch 1's
+            # host phase and the background write of batch 0's durable
+            # state are in flight — exactly the window the pipeline
+            # opened. The newest checkpoint is then truncated mid-file
+            # (a kill -9 landing during the background write itself);
+            # the resume must see the tear, start from the last durable
+            # point (here: nothing — first-ever write torn), replay,
+            # and count every contract exactly once.
+            from mythril_tpu.utils.checkpoint import load_json_checkpoint
+
+            ck7 = os.path.join(d, "ck7")
+            killed = False
+            try:
+                campaign(corpus, ck7, "kill:batch=2",
+                         batch_size=2, pipeline=True).run()
+            except InjectedKill:
+                killed = True
+            p = os.path.join(ck7, "campaign.json")
+            # whether batch 0's background write beat the kill is a
+            # genuine race (that is the point of the leg); both sides
+            # must converge — tear the file when it exists, else the
+            # kill itself already denied durability
+            had_ckpt = os.path.exists(p)
+            if had_ckpt:  # tear the background writer's newest file
+                raw = open(p, "rb").read()
+                with open(p, "wb") as fh:
+                    fh.write(raw[:len(raw) // 2])
+            r7 = campaign(corpus, ck7, None,
+                          batch_size=2, pipeline=True).run()
+            issues = sorted(i["contract"] for i in r7.issues)
+            final = load_json_checkpoint(p)  # newest durable file loads
+            legs["pipeline"] = {
+                "killed": killed, "had_ckpt": had_ckpt,
+                "batches": r7.batches, "issues": issues,
+                "final_next_batch": final.get("next_batch"),
+                "batch_status": r7.batch_status}
+            ok &= (killed and r7.batches == 3
+                   and issues == ["c000", "c002", "c004"]
+                   and len(r7.issues) == 3        # nothing counted twice
+                   and not r7.quarantined
+                   and final.get("next_batch") == 3)
 
     print(json.dumps({"ok": bool(ok), "legs": legs}))
     return 0 if ok else 1

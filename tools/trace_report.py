@@ -7,7 +7,10 @@ Reads either output of the span tracer — the Chrome-trace JSON
   1. top spans by total wall time (count / total / mean / max per name),
   2. a batch stall table (slowest campaign batches with their status),
   3. the degrade timeline (every ladder step, in order),
-  4. a checkpoint summary (saves/loads, total and worst latency).
+  4. a checkpoint summary (saves/loads, total and worst latency),
+  5. a pipeline overlap summary (device/host phase totals, stall time
+     by direction, and how much host-phase time the pipelined campaign
+     hid behind device execution — docs/performance.md).
 
 Usage:
     python tools/trace_report.py t.json [--top N]
@@ -176,6 +179,40 @@ def report(spans: List[Dict], instants: List[Dict], top: int = 10) -> str:
                        f"worst {_fmt_s(max(s['dur'] for s in loads)).strip()}")
     else:
         out.append("(no checkpoint spans)")
+
+    # 5. pipeline overlap: how much host-phase (modules + solver) time
+    # the pipelined campaign hid behind device execution
+    dev = [s for s in spans if s["name"] == "device_phase"]
+    host = [s for s in spans if s["name"] == "host_phase"]
+    stalls = [s for s in spans if s["name"] == "pipeline_stall"]
+    out.append("")
+    out.append("== pipeline overlap ==")
+    if dev or host or stalls:
+        dev_tot = sum(s["dur"] for s in dev)
+        host_tot = sum(s["dur"] for s in host)
+        by_dir: Dict[str, float] = {}
+        for s in stalls:
+            k = str(s["args"].get("wait", "?"))
+            by_dir[k] = by_dir.get(k, 0.0) + s["dur"]
+        dwh = by_dir.get("device-waits-host", 0.0)
+        hwd = by_dir.get("host-waits-device", 0.0)
+        hidden = max(0.0, host_tot - dwh)
+        out.append(f"device phases: {len(dev):>4}  total "
+                   f"{_fmt_s(dev_tot).strip()}")
+        out.append(f"host phases:   {len(host):>4}  total "
+                   f"{_fmt_s(host_tot).strip()}")
+        out.append(f"stall device-waits-host: {_fmt_s(dwh).strip()}   "
+                   f"host-waits-device: {_fmt_s(hwd).strip()}")
+        if host_tot > 0:
+            out.append(f"host time hidden behind device execution: "
+                       f"{_fmt_s(hidden).strip()} "
+                       f"({100.0 * hidden / host_tot:.0f}% of host work)")
+        drained = sum(1 for s in spans if s["name"] == "batch"
+                      and s["args"].get("drained"))
+        if drained:
+            out.append(f"batches drained to the serial path: {drained}")
+    else:
+        out.append("(no pipeline spans — serial run or --no-pipeline)")
     return "\n".join(out)
 
 
