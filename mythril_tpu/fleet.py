@@ -1,0 +1,519 @@
+"""Elastic fleet campaigns: a filesystem-coordinated work ledger.
+
+The static ``--num-hosts/--host-index`` strided split hands each host a
+fixed 1/N of the corpus with no cross-host contract: if one host of
+eight dies, its slice is silently never analyzed, and
+``merge_campaigns`` happily sums whatever per-host JSONs it is given —
+double-counting duplicates, never flagging the gap. This module is the
+cross-host contract (docs/fleet.md):
+
+- the corpus is cut into deterministic WORK UNITS (chunks of contracts,
+  stamped with a corpus fingerprint + unit id) recorded once in a
+  shared ``manifest.json``;
+- workers CLAIM units via atomic lease files (``O_CREAT|O_EXCL`` — the
+  filesystem is the lock; the ledger dir lives on the same shared
+  NFS/GCS mount the per-host checkpoints already use, so no network
+  daemon is needed);
+- a claimed lease is HEARTBEAT-renewed (``os.utime``) by a background
+  thread while the unit runs; a lease whose heartbeat exceeds the TTL
+  is RECLAIMED by any live worker (atomic ``rename`` arbitration), so
+  a killed or wedged host's units migrate to survivors instead of
+  vanishing;
+- reclaims are BOUNDED (``max_leases`` grants per unit) — a unit that
+  keeps killing its workers is marked ``lost`` rather than retried
+  forever, the fleet-level analog of the campaign's bisect-to-
+  quarantine;
+- a finished unit COMMITS one result file via hard-link-exclusive
+  create: the first commit wins, a racing duplicate commit (split
+  brain: a worker that was reclaimed-from but came back) is detected
+  and dropped with an event — the foundation of ``merge_campaigns``'s
+  exactly-once accounting and coverage manifest.
+
+Every lease transition lands on the telemetry spine
+(docs/observability.md): ``lease_claimed`` / ``lease_reclaimed`` /
+``unit_committed`` / ``unit_lost`` / ``unit_duplicate`` events plus
+``fleet_units_{claimed,reclaimed,lost}_total`` counters and a
+``fleet_lease_age_seconds`` gauge (oldest live heartbeat observed — how
+close the fleet runs to its TTL).
+
+Import cost is deliberately light (stdlib + utils.checkpoint's durable
+write helpers): ``campaign-merge`` over a ledger dir must run on a
+backend-free host.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .obs import metrics as obs_metrics
+from .obs import trace as obs_trace
+from .utils.checkpoint import fsync_dir
+
+#: on-disk manifest schema (bump on breaking layout changes; readers
+#: reject newer-than-known versions)
+LEDGER_SCHEMA = 1
+
+_MANIFEST = "manifest.json"
+_UNITS_DIR = "units"
+
+
+def corpus_fingerprint(contracts: Sequence[tuple]) -> str:
+    """Stable identity of an ordered ``(name, bytecode)`` corpus slice:
+    16 hex chars of sha256 over names + per-contract code digests. Two
+    corpora of equal length but different content fingerprint apart —
+    the property the checkpoint shard stamp and the fleet manifest both
+    need (a count alone cannot tell "same corpus" from "same size")."""
+    h = hashlib.sha256()
+    for name, code in contracts:
+        h.update(str(name).encode())
+        h.update(b"\0")
+        h.update(hashlib.sha256(bytes(code)).digest())
+    return h.hexdigest()[:16]
+
+
+def _exclusive_write(path: str, data: bytes) -> bool:
+    """Atomically create ``path`` with ``data`` IFF it does not already
+    exist: tmp file + fsync + ``os.link`` (which fails with EEXIST
+    instead of overwriting, unlike rename). Returns whether this caller
+    won — the primitive behind first-commit-wins and create-once
+    manifests. The tmp name carries pid AND thread id so in-process
+    fleets (threaded workers) never collide."""
+    tmp = f"{path}.{os.getpid()}-{threading.get_ident()}.tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    try:
+        os.link(tmp, path)
+        won = True
+    except FileExistsError:
+        won = False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    if won:
+        fsync_dir(path)
+    return won
+
+
+@dataclass
+class WorkUnit:
+    """One claimed work unit: ``uid`` names it in the ledger, ``start``
+    indexes its first contract in the manifest order, ``names`` are its
+    contracts, ``attempt`` is which lease grant this is (1 = first
+    claim; reclaims increment)."""
+
+    uid: str
+    index: int
+    start: int
+    names: List[str]
+    attempt: int
+
+
+class WorkLedger:
+    """Filesystem work ledger in a shared directory.
+
+    Layout (all writes atomic — claim via ``O_EXCL``, commit/lost via
+    link-exclusive create, heartbeat via ``utime``)::
+
+        <dir>/manifest.json          corpus fingerprint + unit layout
+        <dir>/units/u00000.lease     held lease (mtime = heartbeat)
+        <dir>/units/u00000.result.json  committed unit result (wins)
+        <dir>/units/u00000.lost      re-lease cap exhausted
+
+    ``on_event(kind, **attrs)`` receives lease-lifecycle events (the
+    campaign routes them into ``backend_events`` + the trace bus);
+    without one they go to the trace bus directly.
+    """
+
+    def __init__(self, path: str, ttl: float = 60.0, max_leases: int = 3,
+                 worker: Optional[str] = None,
+                 on_event: Optional[Callable] = None):
+        self.path = path
+        self.ttl = max(0.05, float(ttl))
+        self.max_leases = max(1, int(max_leases))
+        self.worker = worker or (
+            f"{socket.gethostname()}-{os.getpid():x}"
+            f"-{threading.get_ident():x}")
+        self.on_event = on_event
+        self.corpus: Optional[str] = None
+        self.unit_size = 0
+        self.names: List[str] = []
+        self.n_units = 0
+
+    # --- events / metrics ----------------------------------------------
+    def _event(self, kind: str, **kw) -> None:
+        if self.on_event is not None:
+            self.on_event(kind, **kw)
+        else:
+            obs_trace.event(kind, worker=self.worker, **kw)
+
+    # --- paths ----------------------------------------------------------
+    @staticmethod
+    def uid(index: int) -> str:
+        return f"u{index:05d}"
+
+    def _units_dir(self) -> str:
+        return os.path.join(self.path, _UNITS_DIR)
+
+    def _lease_path(self, uid: str) -> str:
+        return os.path.join(self._units_dir(), uid + ".lease")
+
+    def _result_path(self, uid: str) -> str:
+        return os.path.join(self._units_dir(), uid + ".result.json")
+
+    def _lost_path(self, uid: str) -> str:
+        return os.path.join(self._units_dir(), uid + ".lost")
+
+    # --- manifest --------------------------------------------------------
+    def ensure(self, contracts: Sequence[tuple], unit_size: int) -> None:
+        """Create the manifest (first worker) or verify the existing one
+        matches this worker's corpus + unit layout. A mismatch raises
+        ``ValueError`` — claiming units of a DIFFERENT corpus under the
+        same ledger would attribute results to the wrong contracts."""
+        names = [str(n) for n, _ in contracts]
+        fp = corpus_fingerprint(contracts)
+        unit_size = max(1, int(unit_size))
+        os.makedirs(self._units_dir(), exist_ok=True)
+        doc = {"schema": LEDGER_SCHEMA, "corpus": fp,
+               "unit_size": unit_size, "names": names,
+               "units": (len(names) + unit_size - 1) // unit_size}
+        p = os.path.join(self.path, _MANIFEST)
+        if not _exclusive_write(p, json.dumps(doc, sort_keys=True).encode()):
+            have = self._read_manifest(p)
+            if (have.get("corpus") != fp
+                    or int(have.get("unit_size", 0)) != unit_size
+                    or have.get("names") != names):
+                raise ValueError(
+                    f"fleet ledger {self.path} was initialized for a "
+                    f"different corpus/unit layout (manifest corpus "
+                    f"{have.get('corpus')!r} x unit_size "
+                    f"{have.get('unit_size')}, this worker has {fp!r} x "
+                    f"{unit_size}); point every worker at the same "
+                    "corpus or use a fresh ledger dir")
+            doc = have
+        self.corpus = str(doc["corpus"])
+        self.unit_size = int(doc["unit_size"])
+        self.names = list(doc["names"])
+        self.n_units = int(doc["units"])
+
+    def load_manifest(self) -> None:
+        """Attach to an existing ledger (merge/tools path — no corpus in
+        hand to verify against)."""
+        doc = self._read_manifest(os.path.join(self.path, _MANIFEST))
+        self.corpus = str(doc.get("corpus", ""))
+        self.unit_size = max(1, int(doc.get("unit_size", 1)))
+        self.names = list(doc.get("names") or [])
+        self.n_units = int(doc.get("units")
+                           or (len(self.names) + self.unit_size - 1)
+                           // self.unit_size)
+
+    def _read_manifest(self, p: str) -> Dict:
+        try:
+            with open(p) as fh:
+                doc = json.load(fh)
+        except FileNotFoundError:
+            raise ValueError(
+                f"{self.path}: no fleet manifest (not a ledger dir?)"
+            ) from None
+        except ValueError as e:
+            raise ValueError(f"{p}: unreadable fleet manifest ({e})") from e
+        if not isinstance(doc, dict):
+            raise ValueError(f"{p}: fleet manifest is not a JSON object")
+        if int(doc.get("schema", 1)) > LEDGER_SCHEMA:
+            raise ValueError(
+                f"{p}: ledger schema v{doc.get('schema')} is newer than "
+                f"this reader (supports <= v{LEDGER_SCHEMA})")
+        return doc
+
+    def manifest_summary(self) -> Dict:
+        """The manifest as embedded in a worker's report ``fleet``
+        section — what ``merge_campaigns`` needs for the coverage
+        manifest (unit→contracts is rebuilt from names + unit_size)."""
+        return {"corpus": self.corpus, "unit_size": self.unit_size,
+                "units": self.n_units, "names": list(self.names)}
+
+    def unit_names(self, index: int) -> List[str]:
+        s = index * self.unit_size
+        return self.names[s:s + self.unit_size]
+
+    # --- claim / reclaim -------------------------------------------------
+    def _scan_order(self) -> range:
+        return range(self.n_units)
+
+    def _claim_offset(self) -> int:
+        # start the scan at a worker-dependent offset so N workers
+        # hitting a fresh ledger don't all fight over unit 0
+        return (int(hashlib.sha256(self.worker.encode()).hexdigest()[:8],
+                    16) % self.n_units) if self.n_units else 0
+
+    def _try_claim(self, index: int, attempt: int) -> Optional[WorkUnit]:
+        uid = self.uid(index)
+        p = self._lease_path(uid)
+        try:
+            fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return None
+        try:
+            os.write(fd, json.dumps(
+                {"worker": self.worker, "attempt": attempt,
+                 "claimed_t": round(time.time(), 3)}).encode())
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        obs_metrics.REGISTRY.counter(
+            "fleet_units_claimed_total",
+            help="work-unit leases granted to this process").inc()
+        self._event("lease_claimed", unit=uid, attempt=attempt)
+        return WorkUnit(uid=uid, index=index,
+                        start=index * self.unit_size,
+                        names=self.unit_names(index), attempt=attempt)
+
+    def _try_reclaim(self, index: int, age: float) -> Optional[WorkUnit]:
+        """Arbitrate a stale lease: the atomic rename-aside decides one
+        winner among racing reclaimers; the winner re-leases the unit
+        (attempt+1) or, past the cap, marks it lost."""
+        uid = self.uid(index)
+        lease = self._lease_path(uid)
+        tomb = f"{lease}.{os.getpid()}-{threading.get_ident()}.reclaim"
+        try:
+            os.rename(lease, tomb)
+        except OSError:
+            return None  # another worker won the reclaim (or commit)
+        try:
+            with open(tomb) as fh:
+                prev = json.load(fh)
+        except (OSError, ValueError):
+            prev = {}  # torn lease write: the holder died mid-claim
+        try:
+            os.unlink(tomb)
+        except OSError:
+            pass
+        spent = max(1, int(prev.get("attempt", 1) or 1))
+        holder = str(prev.get("worker", "?"))
+        if spent >= self.max_leases:
+            if _exclusive_write(self._lost_path(uid), json.dumps(
+                    {"unit": uid, "attempts": spent, "last_worker": holder,
+                     "t": round(time.time(), 3)}).encode()):
+                obs_metrics.REGISTRY.counter(
+                    "fleet_units_lost_total",
+                    help="units abandoned after the re-lease cap").inc()
+                self._event("unit_lost", unit=uid, attempts=spent,
+                            detail=f"re-lease cap {self.max_leases} "
+                                   f"exhausted (last holder {holder})")
+            return None
+        unit = self._try_claim(index, attempt=spent + 1)
+        if unit is not None:
+            obs_metrics.REGISTRY.counter(
+                "fleet_units_reclaimed_total",
+                help="stale leases taken over from a dead/wedged "
+                     "worker").inc()
+            self._event("lease_reclaimed", unit=uid, attempt=spent + 1,
+                        prev_worker=holder, age=round(age, 3))
+        return unit
+
+    def claim_next(self) -> Optional[WorkUnit]:
+        """Claim the next available unit: an unleased unit directly, or
+        a stale lease (heartbeat older than the TTL) via reclaim.
+        Returns ``None`` when nothing is claimable right now — the
+        caller should check :meth:`pending` and poll (outstanding
+        leases may yet expire)."""
+        now = time.time()
+        oldest_live = 0.0
+        claimed: Optional[WorkUnit] = None
+        off = self._claim_offset()
+        for j in self._scan_order():
+            k = (j + off) % self.n_units
+            uid = self.uid(k)
+            if (os.path.exists(self._result_path(uid))
+                    or os.path.exists(self._lost_path(uid))):
+                continue
+            lease = self._lease_path(uid)
+            if claimed is not None:
+                # keep sweeping only for the lease-age gauge
+                try:
+                    oldest_live = max(
+                        now - os.stat(lease).st_mtime, oldest_live)
+                except OSError:
+                    pass
+                continue
+            try:
+                st = os.stat(lease)
+            except FileNotFoundError:
+                claimed = self._try_claim(k, attempt=1)
+                continue
+            age = now - st.st_mtime
+            if age <= self.ttl:
+                oldest_live = max(age, oldest_live)
+                continue
+            claimed = self._try_reclaim(k, age)
+        obs_metrics.REGISTRY.gauge(
+            "fleet_lease_age_seconds",
+            help="oldest live lease heartbeat age observed this "
+                 "sweep").set(oldest_live)
+        return claimed
+
+    def pending(self) -> bool:
+        """Units neither committed nor lost remain (some may be leased
+        by other workers — they become claimable when the TTL lapses)."""
+        for k in self._scan_order():
+            uid = self.uid(k)
+            if not (os.path.exists(self._result_path(uid))
+                    or os.path.exists(self._lost_path(uid))):
+                return True
+        return False
+
+    # --- heartbeat -------------------------------------------------------
+    def renew(self, unit: WorkUnit) -> None:
+        """Stamp the lease heartbeat (mtime). Best-effort: a missing
+        file means the unit was committed (by us) or reclaimed (we were
+        presumed dead) — either way commit-time arbitration decides, so
+        the renew just stops."""
+        try:
+            os.utime(self._lease_path(unit.uid))
+        except OSError:
+            return
+        obs_trace.event("lease_renew", unit=unit.uid, worker=self.worker)
+
+    class _Renewer:
+        def __init__(self, ledger: "WorkLedger", unit: WorkUnit,
+                     interval: float):
+            self._ledger = ledger
+            self._unit = unit
+            self._interval = interval
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._beat, daemon=True,
+                name=f"lease:{unit.uid}")
+
+        def _beat(self) -> None:
+            while not self._stop.wait(self._interval):
+                self._ledger.renew(self._unit)
+
+        def __enter__(self) -> "WorkLedger._Renewer":
+            self._thread.start()
+            return self
+
+        def __exit__(self, *exc) -> bool:
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+            return False
+
+    def renewer(self, unit: WorkUnit) -> "WorkLedger._Renewer":
+        """Context manager: heartbeat the lease from a background
+        thread every ``ttl/3`` while the unit runs. The heartbeat
+        proves the PROCESS is alive; a wedged batch inside a live
+        process is the batch watchdog's job (docs/fleet.md failure
+        matrix). A real SIGKILL stops the thread with the process, so
+        the lease goes stale exactly when the worker dies."""
+        return WorkLedger._Renewer(self, unit,
+                                   max(0.02, self.ttl / 3.0))
+
+    # --- commit / release ------------------------------------------------
+    def commit(self, unit: WorkUnit, record: Dict) -> bool:
+        """Durably commit the unit's result. First commit wins; a
+        duplicate (split-brain: this worker was reclaimed-from but came
+        back and finished anyway) returns False with a
+        ``unit_duplicate`` event — the caller must DROP its copy of the
+        results so nothing is double-counted."""
+        data = json.dumps(record, sort_keys=True).encode()
+        if _exclusive_write(self._result_path(unit.uid), data):
+            self.release(unit)
+            self._event("unit_committed", unit=unit.uid,
+                        attempt=unit.attempt)
+            return True
+        self._event("unit_duplicate", unit=unit.uid, attempt=unit.attempt,
+                    detail="result already committed by another worker; "
+                           "dropping this copy")
+        return False
+
+    def release(self, unit: WorkUnit) -> None:
+        """Drop our lease if we still hold it (commit cleanup, or a
+        deadline abort returning the unit to the pool without burning a
+        re-lease grant)."""
+        p = self._lease_path(unit.uid)
+        try:
+            with open(p) as fh:
+                cur = json.load(fh)
+        except (OSError, ValueError):
+            return
+        if (cur.get("worker") == self.worker
+                and int(cur.get("attempt", -1) or -1) == unit.attempt):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    # --- inspection ------------------------------------------------------
+    def lost_units(self) -> List[Dict]:
+        """Every ``lost`` marker, with the unit's contract names — the
+        merge's input for the ``lost`` coverage bucket. A unit that was
+        ALSO committed (marked lost, then a presumed-dead worker came
+        back and won the commit race) is excluded: results win."""
+        out = []
+        for k in self._scan_order():
+            uid = self.uid(k)
+            p = self._lost_path(uid)
+            if not os.path.exists(p) \
+                    or os.path.exists(self._result_path(uid)):
+                continue
+            try:
+                with open(p) as fh:
+                    doc = json.load(fh)
+            except (OSError, ValueError):
+                doc = {}
+            out.append({"unit": uid, "contracts": self.unit_names(k),
+                        "attempts": int(doc.get("attempts", 0) or 0),
+                        "last_worker": str(doc.get("last_worker", "?"))})
+        return out
+
+    def committed(self) -> List[Tuple[str, str]]:
+        """``(uid, result_path)`` for every committed unit."""
+        out = []
+        for k in self._scan_order():
+            uid = self.uid(k)
+            p = self._result_path(uid)
+            if os.path.exists(p):
+                out.append((uid, p))
+        return out
+
+
+def ledger_results(path: str) -> List[Dict]:
+    """Synthesize ``merge_campaigns`` input straight from a ledger dir:
+    one pseudo-host result carrying every committed unit record, the
+    lost list, and the manifest. This is how a killed worker's finished
+    units (durably in the ledger, never in any per-worker report JSON)
+    reach the merged report. An unreadable unit result counts as
+    uncommitted — it surfaces in the coverage manifest as unaccounted,
+    with a ``unit_result_corrupt`` event naming the file."""
+    led = WorkLedger(path)
+    led.load_manifest()
+    units: List[Dict] = []
+    events: List[Dict] = []
+    for uid, p in led.committed():
+        try:
+            with open(p) as fh:
+                units.append(json.load(fh))
+        except (OSError, ValueError) as e:
+            events.append({"kind": "unit_result_corrupt", "unit": uid,
+                           "detail": f"{p}: {e}"[:300]})
+    return [{
+        "wall_sec": 0.0,
+        "backend_events": events,
+        "fleet": {"worker": f"ledger:{os.path.abspath(path)}",
+                  "units": units, "lost": led.lost_units(),
+                  "manifest": led.manifest_summary()},
+    }]
+
+
+__all__ = ["LEDGER_SCHEMA", "WorkLedger", "WorkUnit",
+           "corpus_fingerprint", "ledger_results"]

@@ -197,6 +197,32 @@ def create_parser() -> argparse.ArgumentParser:
                         "heartbeat to stderr at most every SEC seconds "
                         "(contracts done, paths/s, frontier occupancy, "
                         "degrade rung, last-checkpoint age)")
+    a.add_argument("--fleet", metavar="DIR",
+                   help="campaign mode: elastic fleet coordination via "
+                        "a shared work-ledger directory (NFS/GCS): the "
+                        "corpus is cut into leased work units, workers "
+                        "claim/heartbeat/commit them, and a dead "
+                        "host's units migrate to survivors (see "
+                        "docs/fleet.md); replaces the static "
+                        "--num-hosts/--host-index split")
+    a.add_argument("--lease-ttl", type=float, default=60.0, metavar="SEC",
+                   help="fleet mode: a unit lease whose heartbeat is "
+                        "older than SEC is reclaimed by any live "
+                        "worker (default 60)")
+    a.add_argument("--unit-size", type=int, default=None, metavar="N",
+                   help="fleet mode: contracts per work unit (rounded "
+                        "up to whole batches; default: one batch) — "
+                        "the granularity of reclaim and of loss when a "
+                        "worker dies mid-unit")
+    a.add_argument("--max-unit-leases", type=int, default=3, metavar="N",
+                   help="fleet mode: lease grants per unit before it "
+                        "is marked lost instead of retried forever "
+                        "(default 3 — the fleet-level analog of "
+                        "bisect-to-quarantine)")
+    a.add_argument("--worker-id", metavar="ID", default=None,
+                   help="fleet mode: stable worker identity stamped "
+                        "into leases and unit results (default: "
+                        "hostname-pid-tid)")
     a.add_argument("--num-hosts", type=int, default=0, metavar="N",
                    help="campaign mode: shard the corpus across N hosts; "
                         "this process analyzes slice --host-index "
@@ -264,8 +290,16 @@ def create_parser() -> argparse.ArgumentParser:
     cm = sub.add_parser("campaign-merge",
                         help="merge per-host campaign JSON results into "
                              "corpus-level metrics")
-    cm.add_argument("results", nargs="+", metavar="JSON",
-                    help="campaign output files (one per host)")
+    cm.add_argument("results", nargs="+", metavar="JSON|LEDGER",
+                    help="campaign output files (one per host) and/or "
+                         "fleet ledger directories (--fleet DIR): a "
+                         "directory contributes every committed unit "
+                         "result — including those of workers that "
+                         "died before printing a report")
+    cm.add_argument("--strict-coverage", action="store_true",
+                    help="exit nonzero unless the merged coverage "
+                         "manifest is full (every contract analyzed or "
+                         "quarantined — nothing lost or unaccounted)")
 
     ld = sub.add_parser("list-detectors",
                         help="list registered detection modules")
@@ -510,17 +544,62 @@ def _resolve_hosts(args):
 
 
 def exec_campaign_merge(args) -> int:
-    """Combine per-host campaign JSONs (reference has no analog — corpus
-    scale is this rebuild's north star; SURVEY §5.8 corpus sharding)."""
+    """Combine per-host campaign JSONs and/or fleet ledger dirs
+    (reference has no analog — corpus scale is this rebuild's north
+    star; SURVEY §5.8 corpus sharding, docs/fleet.md exactly-once
+    merge). A missing or malformed input is a one-line typed error and
+    a clean nonzero exit, never a traceback — merge runs on operator
+    laptops against files scp'd off a pod."""
     import json
+    import os
 
     from ..mythril.campaign import merge_campaigns
 
     results = []
     for p in args.results:
-        with open(p) as fh:
-            results.append(json.load(fh))
-    print(json.dumps(merge_campaigns(results), indent=1))
+        if os.path.isdir(p):
+            from ..fleet import ledger_results
+
+            try:
+                results.extend(ledger_results(p))
+            except ValueError as e:
+                print(f"error: campaign-merge: {e}", file=sys.stderr)
+                return 2
+            continue
+        try:
+            with open(p) as fh:
+                doc = json.load(fh)
+        except OSError as e:
+            print(f"error: campaign-merge: cannot read {p}: "
+                  f"{e.strerror or e}", file=sys.stderr)
+            return 2
+        except ValueError as e:
+            print(f"error: campaign-merge: {p} is not valid JSON ({e})",
+                  file=sys.stderr)
+            return 2
+        if not isinstance(doc, dict):
+            print(f"error: campaign-merge: {p}: expected a campaign "
+                  "result object", file=sys.stderr)
+            return 2
+        results.append(doc)
+    merged = merge_campaigns(results)
+    print(json.dumps(merged, indent=1))
+    if args.strict_coverage:
+        cov = merged.get("coverage")
+        if cov is None:
+            print("error: campaign-merge: --strict-coverage needs fleet "
+                  "results (no coverage manifest in the inputs)",
+                  file=sys.stderr)
+            return 2
+        if not cov.get("full"):
+            print("error: campaign-merge: coverage incomplete: "
+                  f"{cov.get('analyzed', 0)} analyzed + "
+                  f"{cov.get('quarantined', 0)} quarantined of "
+                  f"{cov.get('contracts', 0)} contracts "
+                  f"({cov.get('lost', 0)} lost, "
+                  f"{cov.get('unaccounted', 0)} unaccounted)",
+                  file=sys.stderr)
+            return 3
     return 0
 
 
@@ -562,7 +641,21 @@ def _exec_campaign(args) -> int:
             print(f"warning: {flag} has no effect in campaign mode",
                   file=sys.stderr)
     contracts = load_corpus_dir(args.corpus)
-    num_hosts, host_index = _resolve_hosts(args)
+    if args.fleet:
+        # the ledger IS the work distribution: every worker sees the
+        # whole corpus and claims leased units (docs/fleet.md); a
+        # static strided split underneath would desync the manifest
+        if args.num_hosts > 0 or args.host_index >= 0:
+            print("warning: --num-hosts/--host-index are ignored with "
+                  "--fleet (the ledger distributes the work)",
+                  file=sys.stderr)
+        if args.checkpoint_dir:
+            print("warning: --checkpoint-dir is unused with --fleet "
+                  "(per-unit result files are the durable record)",
+                  file=sys.stderr)
+        num_hosts, host_index = 1, 0
+    else:
+        num_hosts, host_index = _resolve_hosts(args)
     campaign = CorpusCampaign(
         contracts,
         batch_size=args.batch_size,
@@ -592,11 +685,18 @@ def _exec_campaign(args) -> int:
         heartbeat_every=args.heartbeat,
         pipeline=args.pipeline,
         solver_workers=args.solver_workers,
+        fleet_dir=args.fleet,
+        lease_ttl=args.lease_ttl,
+        unit_size=args.unit_size,
+        max_unit_leases=args.max_unit_leases,
+        worker_id=args.worker_id,
     )
 
+    unit_word = "unit" if args.fleet else "batch"
+
     def progress(done, total, dt, n_issues):
-        print(f"batch {done}/{total}: {dt:.1f}s, {n_issues} issue(s) so far",
-              file=sys.stderr)
+        print(f"{unit_word} {done}/{total}: {dt:.1f}s, {n_issues} "
+              "issue(s) so far", file=sys.stderr)
 
     res = campaign.run(progress=progress)
     out = res.as_dict()

@@ -32,7 +32,14 @@ streams fixed-shape BATCHES of contracts through ONE compiled program:
   serial path (commits stay in batch order; one host phase in flight);
   ANY fault drains the pipeline back to the serial
   retry/degrade/bisect machinery above, so PR 1/2 semantics hold
-  unchanged.
+  unchanged;
+- with ``fleet_dir`` set (``--fleet``; docs/fleet.md) the campaign is
+  ELASTIC across hosts: workers claim leased work units from a shared
+  filesystem ledger, heartbeat them while running, reclaim a dead
+  host's stale leases, and commit per-unit results exactly once —
+  ``merge_campaigns`` then closes a coverage manifest over
+  analyzed/quarantined/lost. The static ``num_hosts/host_index``
+  strided split stays as the zero-coordination fast path.
 
 CLI: ``python -m mythril_tpu analyze --corpus DIR`` (see interfaces/cli).
 """
@@ -50,6 +57,7 @@ if TYPE_CHECKING:  # import is heavy at runtime (engine); lazy below
     from ..symbolic import SymSpec
 
 from ..config import DEFAULT_LIMITS, DEFAULT_RESILIENCE, LimitsConfig
+from ..fleet import corpus_fingerprint
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..resilience import (BackendManager, BatchTimeout, DeviceLostError,
@@ -111,6 +119,10 @@ class CampaignResult:
     retries: int = 0
     batch_status: List[str] = field(default_factory=list)
     backend_events: List[Dict] = field(default_factory=list)
+    # fleet mode (docs/fleet.md): this worker's committed unit records,
+    # the ledger's lost list, and the manifest merge_campaigns needs for
+    # exactly-once accounting + the coverage manifest
+    fleet: Dict = field(default_factory=dict)
 
     def as_dict(self) -> Dict:
         # rates derive from the per-batch wall times, which the
@@ -149,6 +161,7 @@ class CampaignResult:
             "batch_status": self.batch_status,
             "backend_events": self.backend_events,
             **({"iprof": self.iprof} if self.iprof else {}),
+            **({"fleet": self.fleet} if self.fleet else {}),
         }
 
 
@@ -185,6 +198,11 @@ class CorpusCampaign:
         heartbeat_every: Optional[float] = None,
         pipeline: bool = False,
         solver_workers: int = 1,
+        fleet_dir: Optional[str] = None,
+        lease_ttl: float = 60.0,
+        unit_size: Optional[int] = None,
+        max_unit_leases: int = 3,
+        worker_id: Optional[str] = None,
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -197,12 +215,23 @@ class CorpusCampaign:
         # the per-host results into corpus-level metrics.
         if not (0 <= host_index < num_hosts):
             raise ValueError(f"host_index {host_index} not in [0, {num_hosts})")
+        if fleet_dir is not None and num_hosts > 1:
+            # the ledger IS the work distribution — layering a static
+            # strided split under it would hand each worker a different
+            # corpus view and break the shared manifest
+            raise ValueError("--fleet replaces --num-hosts/--host-index: "
+                             "every worker sees the whole corpus and "
+                             "claims units from the shared ledger")
         self.num_hosts = num_hosts
         self.host_index = host_index
         contracts = list(contracts)
         if num_hosts > 1:
             contracts = contracts[host_index::num_hosts]
         self.contracts = contracts
+        # content identity of THIS host's slice: stamped into campaign
+        # checkpoints (a resumed run must prove it is analyzing the same
+        # contracts, not just the same count) and the fleet manifest
+        self._corpus_fp = corpus_fingerprint(contracts)
         self.batch_size = batch_size
         self.lanes_per_contract = lanes_per_contract
         self.limits = limits
@@ -279,15 +308,43 @@ class CorpusCampaign:
         # cumulative overlap accounting for the pipeline_occupancy gauge
         self._pipe_host_sec = 0.0
         self._pipe_hidden_sec = 0.0
+        # elastic fleet mode (docs/fleet.md): when set, run() claims
+        # leased work units from the shared ledger instead of walking a
+        # static slice; durability is per-unit result files (the
+        # per-host JSON checkpoint is not used). Unit size rounds up to
+        # a whole number of batches so global batch indices stay
+        # deterministic across workers (fault specs, trace correlation).
+        self.fleet_dir = fleet_dir
+        self.lease_ttl = float(lease_ttl)
+        self.max_unit_leases = int(max_unit_leases)
+        self.worker_id = worker_id
+        us = unit_size if unit_size else batch_size
+        self.unit_size = ((max(1, int(us)) + batch_size - 1)
+                          // batch_size) * batch_size
 
     # --- checkpointing -------------------------------------------------
     @property
     def _ckpt_path(self) -> Optional[str]:
         if self.checkpoint_dir is None:
             return None
+        # the name embeds BOTH shard coordinates: host 1 of a 4-wide
+        # fleet and host 1 of an 8-wide fleet must not collide on one
+        # file in the shared checkpoint dir (pre-fleet runs named only
+        # the index — see MIGRATING.md)
         name = ("campaign.json" if self.num_hosts == 1
-                else f"campaign_host{self.host_index}.json")
+                else f"campaign_host{self.host_index}"
+                     f"of{self.num_hosts}.json")
         return os.path.join(self.checkpoint_dir, name)
+
+    @property
+    def _shard_stamp(self) -> List:
+        """Identity of this host's slice as persisted in the campaign
+        checkpoint: fleet width, host index, slice length, and the
+        slice's CONTENT fingerprint — a count alone cannot tell "same
+        corpus" from "same size", and resuming a cursor over different
+        contracts silently skips/double-attributes work."""
+        return [self.num_hosts, self.host_index, len(self.contracts),
+                self._corpus_fp]
 
     def _event(self, kind: str, detail: str = "", **kw) -> None:
         # both clocks on purpose: wall (`t`) survives the checkpoint
@@ -336,28 +393,44 @@ class CorpusCampaign:
         if state is not None:
             # a checkpoint taken under a different sharding (or corpus)
             # indexes a DIFFERENT contract slice — resuming it would
-            # silently skip contracts and double-attribute issues
+            # silently skip contracts and double-attribute issues.
+            # REFUSE the resume: set the stale file aside (so the next
+            # save's rotation can't clobber evidence) and start fresh,
+            # with the decision on the event record. Pre-fingerprint
+            # checkpoints stamped only [num_hosts, host_index, count];
+            # they keep resuming when those three still match.
             shard = state.get("shard")
-            want = [self.num_hosts, self.host_index, len(self.contracts)]
-            if shard is not None and shard != want:
-                raise ValueError(
-                    f"checkpoint {p} was taken with (num_hosts, host_index,"
-                    f" shard_contracts)={shard}, current run is {want}; "
-                    "delete the checkpoint or relaunch with the original "
-                    "sharding")
-            # resilience fields arrived after the first checkpoint
-            # schema; an old (or hand-rewound) file resumes cleanly
-            for k, v in (("quarantined", []), ("retries", 0),
-                         ("batch_status", []), ("backend_events", [])):
-                state.setdefault(k, v)
-            return state
+            want = self._shard_stamp
+            ok = (shard is None or shard == want
+                  or (isinstance(shard, list) and len(shard) == 3
+                      and shard == want[:3]))
+            if not ok:
+                self._event(
+                    "checkpoint_reset",
+                    detail=f"{p}: shard config changed (checkpoint "
+                           f"{shard}, current {want}); refusing to "
+                           "resume a different corpus slice — starting "
+                           "fresh")
+                for stale in (p, p + ".1"):
+                    if os.path.exists(stale):
+                        try:
+                            os.replace(stale, stale + ".stale")
+                        except OSError:
+                            pass
+                state = None
+            else:
+                # resilience fields arrived after the first checkpoint
+                # schema; an old (or hand-rewound) file resumes cleanly
+                for k, v in (("quarantined", []), ("retries", 0),
+                             ("batch_status", []), ("backend_events", [])):
+                    state.setdefault(k, v)
+                return state
         return {"next_batch": 0, "issues": [], "batch_wall": [],
                 "paths_total": 0, "dropped_forks": 0, "iprof": {},
                 "solver": {},
                 "quarantined": [], "retries": 0, "batch_status": [],
                 "backend_events": [],
-                "shard": [self.num_hosts, self.host_index,
-                          len(self.contracts)]}
+                "shard": self._shard_stamp}
 
     @staticmethod
     def _snapshot_state(state: Dict) -> Dict:
@@ -907,16 +980,168 @@ class CorpusCampaign:
             # harmlessly and the pool reaps it
             pool.shutdown(wait=False)
 
-    # --- the campaign --------------------------------------------------
-    def run(self, progress=None) -> CampaignResult:
+    # --- elastic fleet mode (docs/fleet.md) -----------------------------
+    def _run_unit(self, ledger, unit,
+                  deadline: Optional[float] = None) -> Optional[Dict]:
+        """Analyze one claimed work unit: its contracts stream through
+        the same resilient batch machinery as a static run (retry /
+        degrade / bisect / quarantine all apply within the unit), under
+        a background lease heartbeat. Batch indices are GLOBAL
+        (``unit.start // batch_size`` + offset) so fault specs and trace
+        correlation mean the same thing on every worker. Returns the
+        self-contained unit record the ledger commits — the durable,
+        merge-ready account of exactly these contracts — or ``None``
+        when the deadline expired mid-unit (the lease is released so
+        another worker picks the unit up without burning a re-lease
+        grant)."""
+        from ..smt.solver import SOLVER_STATS
+
+        stats0 = SOLVER_STATS.snapshot()
+        rec: Dict = {"unit": unit.uid, "attempt": unit.attempt,
+                     "worker": ledger.worker, "corpus": ledger.corpus,
+                     "contracts": list(unit.names),
+                     "issues": [], "paths_total": 0, "dropped_forks": 0,
+                     "batches": 0, "batch_wall": [], "batch_status": [],
+                     "quarantined": [], "retries": 0, "iprof": {}}
+        items = self.contracts[unit.start:unit.start + len(unit.names)]
+        base_bi = unit.start // self.batch_size
+        reg = obs_metrics.REGISTRY
+        with ledger.renewer(unit):
+            for j in range(0, len(items), self.batch_size):
+                if deadline is not None and time.monotonic() >= deadline:
+                    ledger.release(unit)
+                    return None
+                bi = base_bi + j // self.batch_size
+                batch = items[j:j + self.batch_size]
+                with obs_trace.timer("batch", bi=bi, n=len(batch),
+                                     unit=unit.uid) as sp:
+                    out = self._run_batch_resilient(bi, batch)
+                self._emit_backend_events()
+                obs_trace.event("batch_status", bi=bi, unit=unit.uid,
+                                status=out["status"],
+                                dur=round(sp.elapsed, 6))
+                reg.counter("batches_total").inc()
+                reg.histogram("batch_seconds",
+                              help="per-batch wall time").observe(
+                    sp.elapsed)
+                reg.counter("batch_retries_total").inc(out["retries"])
+                reg.counter("contracts_quarantined_total").inc(
+                    len(out["quarantined"]))
+                for i in out["issues"]:
+                    i["unit"] = unit.uid
+                for q in out["quarantined"]:
+                    q["unit"] = unit.uid
+                rec["issues"].extend(out["issues"])
+                rec["paths_total"] += out["paths"]
+                rec["dropped_forks"] += out["dropped"]
+                rec["batches"] += 1
+                rec["batch_wall"].append(round(sp.elapsed, 6))
+                rec["batch_status"].append(out["status"])
+                rec["quarantined"].extend(out["quarantined"])
+                rec["retries"] += out["retries"]
+                for k, v in out["iprof"].items():
+                    rec["iprof"][k] = rec["iprof"].get(k, 0) + v
+        rec["solver"] = {k: round(v, 3)
+                         for k, v in SOLVER_STATS.delta(stats0).items()}
+        return rec
+
+    def _run_fleet(self, progress=None) -> CampaignResult:
+        """Claim→run→commit loop against the shared work ledger
+        (docs/fleet.md). Durability is the per-unit result files — the
+        per-host JSON checkpoint is not written (a dead worker's units
+        are re-leased whole, so there is no mid-unit cursor to
+        persist). The loop ends when every unit is committed or lost;
+        while other workers still hold live leases this worker polls,
+        ready to reclaim if their heartbeats go stale. An
+        ``InjectedKill`` (or real signal) blows through uncommitted,
+        leaving our lease to expire — exactly the contract the
+        reclaim path is built on."""
+        from ..fleet import WorkLedger
         from ..smt.solver import SOLVER_STATS
 
         t_start = time.monotonic()
         deadline = (None if self.execution_timeout is None
                     else t_start + self.execution_timeout)
+        stats_at_start = SOLVER_STATS.snapshot()
+        ledger = WorkLedger(self.fleet_dir, ttl=self.lease_ttl,
+                            max_leases=self.max_unit_leases,
+                            worker=self.worker_id, on_event=self._event)
+        ledger.ensure(self.contracts, unit_size=self.unit_size)
+        res = CampaignResult()
+        res.fleet = {"worker": ledger.worker,
+                     "manifest": ledger.manifest_summary(),
+                     "units": [], "lost": []}
+        poll = max(0.05, min(self.lease_ttl / 4.0, 2.0))
+        done_units = 0
+        while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            unit = ledger.claim_next()
+            if unit is None:
+                if not ledger.pending():
+                    break
+                # someone else holds live leases: poll — their units
+                # become reclaimable the moment the heartbeats go stale
+                time.sleep(poll)
+                continue
+            rec = self._run_unit(ledger, unit, deadline)
+            if rec is None:
+                break  # deadline mid-unit; lease already released
+            if ledger.commit(unit, rec):
+                res.issues.extend(rec["issues"])
+                res.paths_total += rec["paths_total"]
+                res.dropped_forks += rec["dropped_forks"]
+                res.batch_wall.extend(rec["batch_wall"])
+                res.batch_status.extend(rec["batch_status"])
+                res.quarantined.extend(rec["quarantined"])
+                res.retries += rec["retries"]
+                for k, v in rec["iprof"].items():
+                    res.iprof[k] = res.iprof.get(k, 0) + v
+                res.fleet["units"].append(rec)
+            # a failed commit (duplicate) already landed its event via
+            # the ledger; the record is DROPPED so nothing counts twice
+            done_units += 1
+            if progress is not None:
+                progress(done_units, ledger.n_units,
+                         sum(rec["batch_wall"]), len(res.issues))
+            if self.heartbeat_every is not None:
+                now = time.monotonic()
+                if (self._last_beat is None
+                        or now - self._last_beat >= self.heartbeat_every):
+                    self._last_beat = now
+                    wall = sum(res.batch_wall)
+                    pps = res.paths_total / wall if wall else 0.0
+                    print(f"heartbeat: unit {rec['unit']} committed "
+                          f"({len(res.fleet['units'])} by this worker), "
+                          f"paths/s {pps:.1f}",
+                          file=sys.stderr, flush=True)
+                    obs_trace.event("heartbeat", unit=rec["unit"],
+                                    units_committed=len(res.fleet["units"]),
+                                    paths_per_sec=round(pps, 1))
+        res.fleet["lost"] = ledger.lost_units()
+        res.batches = len(res.batch_wall)
+        res.contracts = sum(len(u["contracts"])
+                            for u in res.fleet["units"])
+        res.wall_sec = time.monotonic() - t_start
+        res.compile_sec = res.batch_wall[0] if res.batch_wall else 0.0
+        res.backend_events = ((list(self.backend.events)
+                               if self.backend is not None else [])
+                              + list(self._events))
+        res.solver = {k: round(v, 3)
+                      for k, v in SOLVER_STATS.delta(stats_at_start).items()}
+        return res
+
+    # --- the campaign --------------------------------------------------
+    def run(self, progress=None) -> CampaignResult:
+        from ..smt.solver import SOLVER_STATS
+
+        if self.fleet_dir is not None:
+            return self._run_fleet(progress)
+        t_start = time.monotonic()
+        deadline = (None if self.execution_timeout is None
+                    else t_start + self.execution_timeout)
         state = self._load_ckpt()
-        state.setdefault("shard", [self.num_hosts, self.host_index,
-                                   len(self.contracts)])
+        state.setdefault("shard", self._shard_stamp)
         res = CampaignResult()
         res.issues = list(state["issues"])
         res.batch_wall = list(state["batch_wall"])
@@ -1068,23 +1293,91 @@ def merge_campaigns(results: Sequence[Dict]) -> Dict:
     """Combine per-host campaign result dicts (``as_dict()`` shape, with
     optional ``issues_detail``) into corpus-level metrics. Hosts run
     CONCURRENTLY on a pod, so merged wall-clock is the slowest host, while
-    throughput is the corpus total over that wall-clock."""
+    throughput is the corpus total over that wall-clock.
+
+    Fleet results (docs/fleet.md) get EXACTLY-ONCE accounting: a result
+    carrying a ``fleet.units`` list contributes through its unit
+    records, keyed by unit id — the first committed record of a unit
+    wins, any later copy (the same result file merged twice, or a
+    split-brain double account) is dropped with a ``unit_duplicate``
+    event in the merged ``backend_events``. A result ALL of whose units
+    were already merged is discarded wholesale (its events/solver would
+    otherwise double too). The merged report then gains a top-level
+    ``coverage`` manifest built from the ledger manifest: every contract
+    ends in exactly one of ``analyzed`` / ``quarantined`` / ``lost``,
+    with anything else counted ``unaccounted`` — and ``full`` is only
+    True when lost and unaccounted are both zero (the
+    ``campaign-merge --strict-coverage`` gate)."""
+    seen_units: set = set()
+    dup_units: List[Dict] = []
+    manifests: List[Dict] = []
+    unit_rows: List[Dict] = []
+    # (result, fresh-units-or-None); None = legacy per-host result that
+    # contributes through its top-level fields
+    kept: List[tuple] = []
+    for r in results:
+        fl = r.get("fleet") or {}
+        units = fl.get("units")
+        if not isinstance(units, list):
+            kept.append((r, None))
+            continue
+        if isinstance(fl.get("manifest"), dict):
+            manifests.append(fl["manifest"])
+        # a ledger-synthesized pseudo-host (campaign-merge given the
+        # --fleet DIR itself) overlaps worker reports BY CONSTRUCTION —
+        # its copies dedupe silently; only genuine anomalies (the same
+        # result file twice, a split-brain double account) are flagged
+        is_ledger = str(fl.get("worker", "")).startswith("ledger:")
+        fresh = []
+        for u in units:
+            uid = str(u.get("unit"))
+            if uid in seen_units:
+                if not is_ledger:
+                    dup_units.append(
+                        {"unit": uid,
+                         "worker": str(u.get("worker",
+                                             fl.get("worker", "?")))})
+                continue
+            seen_units.add(uid)
+            fresh.append(u)
+        if units and not fresh:
+            # every unit already merged: the same result file twice —
+            # drop the whole host so its events aren't re-counted either
+            continue
+        unit_rows.extend(fresh)
+        kept.append((r, fresh))
+
+    legacy = [r for r, fresh in kept if fresh is None]
     merged: Dict = {
-        "hosts": len(results),
-        "contracts": sum(r.get("contracts", 0) for r in results),
-        "batches": sum(r.get("batches", 0) for r in results),
-        "issues": sum(r.get("issues", 0) for r in results),
-        "wall_sec": max((r.get("wall_sec", 0.0) for r in results),
+        "hosts": len(kept),
+        "contracts": (sum(r.get("contracts", 0) for r in legacy)
+                      + sum(len(u.get("contracts") or [])
+                            for u in unit_rows)),
+        "batches": (sum(r.get("batches", 0) for r in legacy)
+                    + sum(u.get("batches", 0) for u in unit_rows)),
+        "issues": (sum(r.get("issues", 0) for r in legacy)
+                   + sum(len(u.get("issues") or []) for u in unit_rows)),
+        "wall_sec": max((r.get("wall_sec", 0.0) for r, _ in kept),
                         default=0.0),
-        "paths_total": sum(r.get("paths_total", 0) for r in results),
-        "dropped_forks": sum(r.get("dropped_forks", 0) for r in results),
+        "paths_total": (sum(r.get("paths_total", 0) for r in legacy)
+                        + sum(u.get("paths_total", 0)
+                              for u in unit_rows)),
+        "dropped_forks": (sum(r.get("dropped_forks", 0) for r in legacy)
+                          + sum(u.get("dropped_forks", 0)
+                                for u in unit_rows)),
         # resilience fields: quarantine entries already carry their host's
-        # batch index; concatenation in input order keeps them auditable
-        "quarantined": [q for r in results
-                        for q in (r.get("quarantined") or [])],
-        "retries": sum(r.get("retries", 0) for r in results),
-        "batch_status": [s for r in results
-                         for s in (r.get("batch_status") or [])],
+        # batch index (and, for fleet results, their unit id);
+        # concatenation in input order keeps them auditable
+        "quarantined": ([q for r in legacy
+                         for q in (r.get("quarantined") or [])]
+                        + [q for u in unit_rows
+                           for q in (u.get("quarantined") or [])]),
+        "retries": (sum(r.get("retries", 0) for r in legacy)
+                    + sum(u.get("retries", 0) for u in unit_rows)),
+        "batch_status": ([s for r in legacy
+                          for s in (r.get("batch_status") or [])]
+                         + [s for u in unit_rows
+                            for s in (u.get("batch_status") or [])]),
         # per-session event ordering preserved: a plain concatenation
         # interleaves resumed sessions' streams arbitrarily (host A's
         # resume can carry events older than host B's first session).
@@ -1092,20 +1385,27 @@ def merge_campaigns(results: Sequence[Dict]) -> Dict:
         # emission order even where timestamps tie or are missing;
         # legacy events without session/t sort first as one group.
         "backend_events": sorted(
-            (e for r in results for e in (r.get("backend_events") or [])),
+            (e for r, _ in kept
+             for e in (r.get("backend_events") or [])),
             key=lambda e: (str(e.get("session", "")),
                            float(e.get("t", 0.0))
                            if isinstance(e.get("t", 0.0), (int, float))
                            else 0.0)),
     }
+    # the duplicate-drop decisions are part of the merged audit trail
+    merged["backend_events"] += [
+        {"kind": "unit_duplicate", "unit": d["unit"],
+         "worker": d["worker"],
+         "detail": "unit already merged; duplicate copy dropped"}
+        for d in dup_units]
     wall = merged["wall_sec"]
     merged["contracts_per_sec"] = (
         round(merged["contracts"] / wall, 3) if wall else 0.0)
     merged["paths_per_sec"] = (
         round(merged["paths_total"] / wall, 1) if wall else 0.0)
     solver: Dict = {}
-    for r in results:
-        for k, v in (r.get("solver") or {}).items():
+    for src in legacy + unit_rows:
+        for k, v in (src.get("solver") or {}).items():
             if isinstance(v, (int, float)):
                 solver[k] = solver.get(k, 0) + v
     merged["solver"] = solver
@@ -1113,12 +1413,78 @@ def merge_campaigns(results: Sequence[Dict]) -> Dict:
         round(solver.get("unknown", 0) / solver["attempts"], 4)
         if solver.get("attempts") else 0.0)
     iprof: Dict[str, int] = {}
-    for r in results:
-        for k, v in (r.get("iprof") or {}).items():
+    for src in legacy + unit_rows:
+        for k, v in (src.get("iprof") or {}).items():
             iprof[k] = iprof.get(k, 0) + v
     if iprof:
         merged["iprof"] = iprof
-    detail = [i for r in results for i in r.get("issues_detail", [])]
+    detail = ([i for r in legacy for i in r.get("issues_detail", [])]
+              + [i for u in unit_rows for i in (u.get("issues") or [])])
     if detail:
         merged["issues_detail"] = detail
+    if manifests:
+        merged["coverage"] = _fleet_coverage(manifests, unit_rows,
+                                             dup_units, kept)
     return merged
+
+
+def _fleet_coverage(manifests: Sequence[Dict], unit_rows: Sequence[Dict],
+                    dup_units: Sequence[Dict], kept: Sequence[tuple]
+                    ) -> Dict:
+    """The merged coverage manifest: classify every manifest contract as
+    analyzed / quarantined / lost / unaccounted from the unique unit
+    records. ``lost`` takes the ledgers' re-lease-cap markers (a unit
+    that was ALSO committed counts as committed — results win);
+    ``unaccounted`` is whatever no record speaks for (a worker's result
+    file missing from the merge, a unit still leased when the fleet
+    stopped, a corrupt unit result)."""
+    man = manifests[0]
+    mixed = any(m.get("corpus") != man.get("corpus")
+                or m.get("names") != man.get("names")
+                for m in manifests[1:])
+    names = list(man.get("names") or [])
+    us = max(1, int(man.get("unit_size") or 1))
+    n_units = int(man.get("units") or (len(names) + us - 1) // us)
+    committed = {str(u.get("unit")): u for u in unit_rows}
+    lost_ids: Dict[str, Dict] = {}
+    for r, fresh in kept:
+        if fresh is None:
+            continue
+        for lu in (r.get("fleet") or {}).get("lost") or []:
+            uid = str(lu.get("unit"))
+            if uid not in committed:
+                lost_ids.setdefault(uid, lu)
+    analyzed = quarantined = lost = unaccounted = 0
+    unacc_units: List[str] = []
+    for k in range(n_units):
+        uid = f"u{k:05d}"
+        unames = names[k * us:(k + 1) * us]
+        if not unames:
+            break
+        if uid in committed:
+            u = committed[uid]
+            qn = {q.get("name") for q in (u.get("quarantined") or [])}
+            nq = sum(1 for n in unames if n in qn)
+            quarantined += nq
+            analyzed += len(unames) - nq
+        elif uid in lost_ids:
+            lost += len(unames)
+        else:
+            unaccounted += len(unames)
+            unacc_units.append(uid)
+    cov: Dict = {
+        "contracts": len(names),
+        "analyzed": analyzed,
+        "quarantined": quarantined,
+        "lost": lost,
+        "unaccounted": unaccounted,
+        "units_total": n_units,
+        "units_committed": len(committed),
+        "lost_units": sorted(lost_ids),
+        "unaccounted_units": unacc_units,
+        "duplicate_units": sorted({d["unit"] for d in dup_units}),
+        "full": lost == 0 and unaccounted == 0 and not mixed,
+    }
+    if mixed:
+        cov["corpus_mismatch"] = True
+    return cov

@@ -195,13 +195,18 @@ class FaultSpec:
     """One trigger: ``mode`` fires when the batch index and/or contract
     name matches, at most ``times`` times (None = every time — a
     persistent poison; ``times=1`` models a transient fault the
-    retry-once policy cures)."""
+    retry-once policy cures). ``nth=N`` instead fires on the Nth
+    matching attempt seen by THIS process (1-based) — worker-LOCAL
+    ordering, for fleet tests where global batch indices are claimed
+    nondeterministically across racing workers (docs/fleet.md)."""
 
     mode: str
     batch: Optional[int] = None
     contract: Optional[str] = None
     times: Optional[int] = None
+    nth: Optional[int] = None
     fired: int = 0
+    calls: int = 0
 
     def matches(self, batch: Optional[int],
                 contracts: Sequence[str]) -> bool:
@@ -211,12 +216,17 @@ class FaultSpec:
             return False
         if self.contract is not None and self.contract not in contracts:
             return False
+        if self.nth is not None:
+            self.calls += 1
+            if self.calls != self.nth:
+                return False
         return True
 
     @classmethod
     def parse(cls, text: str) -> "FaultSpec":
         """``mode[:key=value]*`` — e.g. ``raise:contract=c002``,
-        ``hang:batch=1``, ``raise:batch=0:times=1``, ``kill:batch=2``."""
+        ``hang:batch=1``, ``raise:batch=0:times=1``, ``kill:batch=2``,
+        ``kill:nth=2`` (this worker's 2nd attempt, wherever it lands)."""
         parts = [p for p in text.strip().split(":") if p]
         if not parts or parts[0] not in FAULT_MODES:
             raise ValueError(
@@ -233,12 +243,18 @@ class FaultSpec:
                 spec.contract = v
             elif k == "times":
                 spec.times = int(v)
+            elif k == "nth":
+                spec.nth = int(v)
+                if spec.nth < 1:
+                    raise ValueError(
+                        f"fault spec {text!r}: nth is 1-based")
             else:
                 raise ValueError(f"fault spec {text!r}: unknown key {k!r}")
-        if spec.batch is None and spec.contract is None:
+        if spec.batch is None and spec.contract is None \
+                and spec.nth is None:
             raise ValueError(
-                f"fault spec {text!r}: need batch= and/or contract= "
-                "(an unconditional fault would poison every batch)")
+                f"fault spec {text!r}: need batch=, contract= and/or "
+                "nth= (an unconditional fault would poison every batch)")
         return spec
 
 

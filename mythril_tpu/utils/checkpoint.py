@@ -100,9 +100,10 @@ def _leaf_sha256(arr: np.ndarray) -> str:
     return h.hexdigest()
 
 
-def _fsync_dir(path: str) -> None:
+def fsync_dir(path: str) -> None:
     """Flush the directory entry so the rename itself survives a power
-    cut (best-effort: not every filesystem supports dir fds)."""
+    cut (best-effort: not every filesystem supports dir fds). Shared
+    with the fleet ledger's link-exclusive writes (mythril_tpu/fleet.py)."""
     d = os.path.dirname(os.path.abspath(path))
     try:
         fd = os.open(d, os.O_RDONLY)
@@ -116,8 +117,11 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def _durable_write(path: str, data: bytes, rotate: bool = True) -> None:
-    """tmp file + flush + fsync + rotate-previous + atomic rename."""
+def durable_write(path: str, data: bytes, rotate: bool = True) -> None:
+    """THE atomic-write discipline every durable artifact in this repo
+    shares (checkpoints here, unit results and manifests in
+    mythril_tpu/fleet.py): tmp file + flush + fsync +
+    rotate-previous + atomic rename."""
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "wb") as fh:
         fh.write(data)
@@ -129,7 +133,7 @@ def _durable_write(path: str, data: bytes, rotate: bool = True) -> None:
         # leaves only <path>.1, which loaders try next
         os.replace(path, path + ROTATE_SUFFIX)
     os.replace(tmp, path)
-    _fsync_dir(path)
+    fsync_dir(path)
 
 
 # --- frontier (npz) checkpoints ---------------------------------------
@@ -158,7 +162,7 @@ def save_frontier(path: str, sf, meta: Dict | None = None,
         np.savez_compressed(buf, **arrays)
         body = buf.getvalue()
         digest = hashlib.sha256(body).hexdigest().encode()
-        _durable_write(path, body + _TRAILER_MAGIC + digest, rotate=rotate)
+        durable_write(path, body + _TRAILER_MAGIC + digest, rotate=rotate)
     obs_metrics.REGISTRY.histogram(
         "checkpoint_write_seconds",
         help="durable checkpoint save latency").observe(sp.elapsed)
@@ -318,7 +322,7 @@ def save_json_checkpoint(path: str, state: Dict, rotate: bool = True) -> None:
         doc = {"__schema__": CHECKPOINT_SCHEMA,
                "sha256": hashlib.sha256(payload.encode()).hexdigest(),
                "state": state}
-        _durable_write(path, json.dumps(doc).encode(), rotate=rotate)
+        durable_write(path, json.dumps(doc).encode(), rotate=rotate)
     obs_metrics.REGISTRY.histogram(
         "checkpoint_write_seconds",
         help="durable checkpoint save latency").observe(sp.elapsed)
@@ -492,7 +496,8 @@ def load_json_checkpoint_resilient(
 
 __all__ = [
     "BackgroundCheckpointWriter", "CHECKPOINT_SCHEMA", "CheckpointCorrupt",
-    "ROTATE_SUFFIX", "load_frontier", "load_frontier_resilient",
-    "load_json_checkpoint", "load_json_checkpoint_resilient",
-    "save_frontier", "save_json_checkpoint",
+    "ROTATE_SUFFIX", "durable_write", "fsync_dir", "load_frontier",
+    "load_frontier_resilient", "load_json_checkpoint",
+    "load_json_checkpoint_resilient", "save_frontier",
+    "save_json_checkpoint",
 ]

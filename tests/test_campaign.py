@@ -105,9 +105,10 @@ def test_campaign_multihost_shard_and_merge(tmp_path):
     assert ({i["contract"] for i in merged["issues_detail"]}
             == {i["contract"] for i in single.issues})
     assert merged["solver"]["attempts"] > 0
-    # per-host checkpoints coexist in the shared dir
-    assert (tmp_path / "ck_mh" / "campaign_host0.json").exists()
-    assert (tmp_path / "ck_mh" / "campaign_host1.json").exists()
+    # per-host checkpoints coexist in the shared dir; the name embeds
+    # BOTH shard coordinates so different fleet widths never collide
+    assert (tmp_path / "ck_mh" / "campaign_host0of2.json").exists()
+    assert (tmp_path / "ck_mh" / "campaign_host1of2.json").exists()
 
 
 def test_campaign_host_index_validation(tmp_path):

@@ -1,0 +1,401 @@
+"""Elastic fleet campaigns: lease ledger, dead-host recovery,
+exactly-once merge accounting (docs/fleet.md).
+
+All cross-host machinery is exercised on CPU with stub batch runners
+(tier-1 fast, like test_resilience.py's supervisor tests): threaded
+workers race one ledger through the real O_EXCL/rename/link protocol,
+kills are the injector's InjectedKill (blows through uncheckpointed
+like SIGKILL), and merges are checked for the acceptance invariants —
+every unit exactly once, duplicates flagged, coverage manifest closed
+over analyzed/quarantined/lost."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.fleet import (WorkLedger, corpus_fingerprint,
+                               ledger_results)
+from mythril_tpu.mythril.campaign import (CorpusCampaign,
+                                          merge_campaigns)
+from mythril_tpu.resilience import (FaultInjector, FaultSpec,
+                                    InjectedKill)
+from mythril_tpu.utils.checkpoint import load_json_checkpoint
+
+N = 6
+CONTRACTS = [(f"c{i:03d}", bytes([i])) for i in range(N)]
+
+
+def _stub_runner(bi, names, codes):
+    return {"issues": [{"contract": n, "batch": bi}
+                       for n in names if not n.startswith("_pad_")],
+            "paths": len(names), "dropped": 0, "iprof": {}}
+
+
+def fleet_campaign(fleet_dir, fault, worker, ttl=0.3, contracts=None,
+                   **kw):
+    return CorpusCampaign(
+        contracts or CONTRACTS, batch_size=2, spec=object(),
+        batch_runner=_stub_runner,
+        fault_injector=FaultInjector.from_string(fault),
+        fleet_dir=fleet_dir, lease_ttl=ttl, worker_id=worker, **kw)
+
+
+# --- corpus identity ---------------------------------------------------
+
+
+def test_corpus_fingerprint_content_sensitive():
+    fp = corpus_fingerprint(CONTRACTS)
+    assert fp == corpus_fingerprint(list(CONTRACTS))
+    # same names + same COUNT but different code must fingerprint apart
+    other = [(n, b"\xff" + c) for n, c in CONTRACTS]
+    assert corpus_fingerprint(other) != fp
+    # order matters: units index into the manifest order
+    assert corpus_fingerprint(list(reversed(CONTRACTS))) != fp
+
+
+def test_ledger_manifest_create_and_mismatch(tmp_path):
+    led = WorkLedger(str(tmp_path / "l"), worker="a")
+    led.ensure(CONTRACTS, unit_size=2)
+    assert led.n_units == 3 and led.unit_size == 2
+    # a second worker attaching with the same corpus verifies cleanly
+    led2 = WorkLedger(str(tmp_path / "l"), worker="b")
+    led2.ensure(CONTRACTS, unit_size=2)
+    assert led2.corpus == led.corpus
+    # ... a different corpus (or unit layout) must be refused: claiming
+    # units of corpus A while holding corpus B misattributes results
+    with pytest.raises(ValueError, match="different corpus"):
+        WorkLedger(str(tmp_path / "l"), worker="c").ensure(
+            [(n, b"\xff" + c) for n, c in CONTRACTS], unit_size=2)
+    with pytest.raises(ValueError, match="different corpus"):
+        WorkLedger(str(tmp_path / "l"), worker="d").ensure(
+            CONTRACTS, unit_size=4)
+
+
+# --- lease contention --------------------------------------------------
+
+
+def test_threaded_workers_claim_each_unit_exactly_once(tmp_path):
+    """Acceptance: workers racing one ledger — the O_EXCL claim is the
+    lock, so across every thread each unit is granted exactly once and
+    committed exactly once."""
+    contracts = [(f"c{i:03d}", bytes([i % 251])) for i in range(24)]
+    path = str(tmp_path / "race")
+    claims: dict = {}
+    lock = threading.Lock()
+
+    def worker(wid):
+        led = WorkLedger(path, ttl=30.0, worker=wid)
+        led.ensure(contracts, unit_size=2)
+        while True:
+            u = led.claim_next()
+            if u is None:
+                if not led.pending():
+                    return
+                time.sleep(0.005)
+                continue
+            with lock:
+                claims.setdefault(u.uid, []).append(wid)
+            assert led.commit(u, {"unit": u.uid, "worker": wid,
+                                  "contracts": u.names})
+
+    threads = [threading.Thread(target=worker, args=(f"w{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    # every unit claimed exactly once, by exactly one worker
+    assert sorted(claims) == [f"u{k:05d}" for k in range(12)]
+    assert all(len(v) == 1 for v in claims.values()), claims
+    led = WorkLedger(path, worker="check")
+    led.load_manifest()
+    assert len(led.committed()) == 12 and not led.lost_units()
+
+
+def test_ttl_expiry_reclaim_and_attempt_count(tmp_path):
+    events = []
+    led_a = WorkLedger(str(tmp_path / "l"), ttl=0.15, worker="a",
+                       on_event=lambda k, **kw: events.append((k, kw)))
+    led_a.ensure(CONTRACTS, unit_size=2)
+    ua = led_a.claim_next()
+    assert ua is not None and ua.attempt == 1
+    # a LIVE lease is not reclaimable: a second worker gets a different
+    # unit, and once all are claimed, nothing at all
+    led_b = WorkLedger(str(tmp_path / "l"), ttl=0.15, worker="b",
+                       on_event=lambda k, **kw: events.append((k, kw)))
+    led_b.ensure(CONTRACTS, unit_size=2)
+    others = [led_b.claim_next(), led_b.claim_next()]
+    assert all(u is not None and u.uid != ua.uid for u in others)
+    assert led_b.claim_next() is None and led_b.pending()
+    # ... until worker a's heartbeat goes stale past the TTL
+    time.sleep(0.2)
+    for u in others:
+        led_b.renew(u)  # keep b's own leases live
+    got = led_b.claim_next()
+    assert got is not None and got.uid == ua.uid and got.attempt == 2
+    kinds = [k for k, _ in events]
+    assert "lease_reclaimed" in kinds
+    rk = dict(events[kinds.index("lease_reclaimed")][1])
+    assert rk["unit"] == ua.uid and rk["prev_worker"] == "a"
+
+
+def test_renewer_heartbeat_prevents_reclaim(tmp_path):
+    led_a = WorkLedger(str(tmp_path / "l"), ttl=0.2, worker="a")
+    led_a.ensure(CONTRACTS[:2], unit_size=2)   # one unit
+    ua = led_a.claim_next()
+    led_b = WorkLedger(str(tmp_path / "l"), ttl=0.2, worker="b")
+    led_b.ensure(CONTRACTS[:2], unit_size=2)
+    with led_a.renewer(ua):
+        time.sleep(0.5)  # well past the TTL — but the heartbeat ticks
+        assert led_b.claim_next() is None
+    time.sleep(0.3)      # heartbeat stopped: now it IS reclaimable
+    got = led_b.claim_next()
+    assert got is not None and got.attempt == 2
+
+
+def test_release_cap_marks_unit_lost(tmp_path):
+    """Acceptance: bounded re-lease — a unit that keeps killing its
+    workers is marked lost (the fleet analog of bisect-to-quarantine),
+    and the merged coverage manifest flags the gap."""
+    path = str(tmp_path / "l")
+    events = []
+    led = WorkLedger(path, ttl=0.05, max_leases=2, worker="w",
+                     on_event=lambda k, **kw: events.append((k, kw)))
+    led.ensure(CONTRACTS[:2], unit_size=2)     # one unit, cap 2
+    assert led.claim_next().attempt == 1       # grant 1 ... dies
+    time.sleep(0.1)
+    assert led.claim_next().attempt == 2       # grant 2 ... dies
+    time.sleep(0.1)
+    assert led.claim_next() is None            # cap: marked lost
+    assert not led.pending()                   # lost = accounted
+    lost = led.lost_units()
+    assert [(l["unit"], l["attempts"]) for l in lost] == [("u00000", 2)]
+    assert lost[0]["contracts"] == ["c000", "c001"]
+    assert "unit_lost" in [k for k, _ in events]
+    merged = merge_campaigns(ledger_results(path))
+    cov = merged["coverage"]
+    assert cov["lost"] == 2 and cov["lost_units"] == ["u00000"]
+    assert not cov["full"]
+
+
+def test_duplicate_commit_split_brain_loses(tmp_path):
+    """First commit wins: a worker that was reclaimed-from but came
+    back (split brain) must see its commit rejected and drop its copy."""
+    path = str(tmp_path / "l")
+    a = WorkLedger(path, ttl=0.05, worker="a")
+    a.ensure(CONTRACTS[:2], unit_size=2)
+    ua = a.claim_next()
+    time.sleep(0.1)
+    b = WorkLedger(path, ttl=0.05, worker="b")
+    b.ensure(CONTRACTS[:2], unit_size=2)
+    ub = b.claim_next()                        # reclaims a's stale lease
+    assert ub.attempt == 2
+    assert b.commit(ub, {"unit": ub.uid, "worker": "b"})
+    assert not a.commit(ua, {"unit": ua.uid, "worker": "a"})
+    doc = json.load(open(os.path.join(path, "units",
+                                      "u00000.result.json")))
+    assert doc["worker"] == "b"
+
+
+# --- fleet campaigns (stub runner) -------------------------------------
+
+
+def test_fleet_kill_reclaim_no_double_count(tmp_path):
+    """Acceptance: 2 workers on one ledger, worker 0 killed mid-batch —
+    the merged report has full coverage (no contract unaccounted), the
+    issue/path counts match a single-worker baseline (nothing double-
+    counted), and a lease_reclaimed event is in backend_events."""
+    baseline = fleet_campaign(str(tmp_path / "solo"), None, "solo").run()
+    assert baseline.contracts == N and len(baseline.issues) == N
+
+    fl = str(tmp_path / "ledger")
+    with pytest.raises(InjectedKill):
+        fleet_campaign(fl, "kill:batch=1", "w0").run()
+    time.sleep(0.35)                           # let w0's lease expire
+    r1 = fleet_campaign(fl, None, "w1").run()
+    kinds = [e["kind"] for e in r1.backend_events]
+    assert "lease_reclaimed" in kinds
+    d1 = r1.as_dict()
+    d1["issues_detail"] = r1.issues
+    # worker reports first, the ledger last: it contributes exactly the
+    # units no surviving report spoke for (w0's pre-kill commits)
+    merged = merge_campaigns([d1] + ledger_results(fl))
+    cov = merged["coverage"]
+    assert cov["full"], cov
+    assert cov["analyzed"] == N and cov["lost"] == 0
+    assert cov["unaccounted"] == 0 and not cov["duplicate_units"]
+    assert merged["contracts"] == baseline.contracts
+    assert merged["issues"] == len(baseline.issues)
+    assert merged["paths_total"] == baseline.paths_total
+    assert (sorted(i["contract"] for i in merged["issues_detail"])
+            == sorted(i["contract"] for i in baseline.issues))
+    assert any(e["kind"] == "lease_reclaimed"
+               for e in merged["backend_events"])
+
+
+def test_fleet_quarantine_lands_in_coverage(tmp_path):
+    """A poison contract quarantined inside a unit shows up in the
+    coverage manifest's quarantined bucket — analyzed + quarantined
+    still closes over the corpus (full coverage, nothing lost)."""
+    fl = str(tmp_path / "ledger")
+    r = fleet_campaign(fl, "raise:contract=c002", "w0").run()
+    assert [q["name"] for q in r.quarantined] == ["c002"]
+    assert r.quarantined[0]["unit"] == "u00001"
+    merged = merge_campaigns(ledger_results(fl))
+    cov = merged["coverage"]
+    assert cov["full"] and cov["quarantined"] == 1
+    assert cov["analyzed"] == N - 1
+
+
+def test_merge_same_result_file_twice_flags_duplicate(tmp_path):
+    """Acceptance: merge_campaigns given the same result twice reports
+    each unit exactly once and flags the duplicate."""
+    fl = str(tmp_path / "ledger")
+    r = fleet_campaign(fl, None, "w0").run()
+    d = r.as_dict()
+    d["issues_detail"] = r.issues
+    once = merge_campaigns([d])
+    twice = merge_campaigns([d, d])
+    assert twice["contracts"] == once["contracts"] == N
+    assert twice["issues"] == once["issues"] == N
+    assert twice["paths_total"] == once["paths_total"]
+    assert twice["coverage"]["duplicate_units"] == [
+        f"u{k:05d}" for k in range(3)]
+    dup_events = [e for e in twice["backend_events"]
+                  if e["kind"] == "unit_duplicate"]
+    assert len(dup_events) == 3
+    # the wholly-duplicate host is dropped — its events don't double
+    assert twice["hosts"] == 1
+
+
+def test_fleet_rejects_static_sharding():
+    with pytest.raises(ValueError, match="fleet"):
+        CorpusCampaign(CONTRACTS, batch_size=2, spec=object(),
+                       batch_runner=_stub_runner,
+                       fleet_dir="/tmp/x", num_hosts=2, host_index=0)
+
+
+def test_fault_spec_nth_is_worker_local():
+    s = FaultSpec.parse("kill:nth=2")
+    assert s.nth == 2
+    assert not s.matches(7, ["a"])       # 1st attempt: no fire
+    assert s.matches(3, ["b"])           # 2nd attempt: fires
+    assert not s.matches(3, ["b"])       # one-shot by construction
+    with pytest.raises(ValueError, match="nth"):
+        FaultSpec.parse("kill:nth=0")
+    with pytest.raises(ValueError):
+        FaultSpec.parse("kill")          # still needs SOME trigger
+
+
+# --- checkpoint shard identity (satellite: refuse the wrong slice) -----
+
+
+def stub_ckpt_campaign(ckpt, contracts=None, fault=None, **kw):
+    return CorpusCampaign(
+        contracts or CONTRACTS, batch_size=2, checkpoint_dir=ckpt,
+        spec=object(), batch_runner=_stub_runner,
+        fault_injector=FaultInjector.from_string(fault), **kw)
+
+
+def test_ckpt_name_embeds_fleet_width(tmp_path):
+    ck = str(tmp_path / "ck")
+    stub_ckpt_campaign(ck, num_hosts=2, host_index=0).run()
+    stub_ckpt_campaign(ck, num_hosts=3, host_index=0).run()
+    # different widths never collide on one file in the shared dir
+    assert os.path.exists(os.path.join(ck, "campaign_host0of2.json"))
+    assert os.path.exists(os.path.join(ck, "campaign_host0of3.json"))
+    state = load_json_checkpoint(
+        os.path.join(ck, "campaign_host0of2.json"))
+    assert state["shard"][:2] == [2, 0] and len(state["shard"]) == 4
+
+
+def test_ckpt_corpus_change_resets_instead_of_wrong_slice(tmp_path):
+    """Same count, different contracts: resuming the old cursor would
+    silently skip half the new corpus — the campaign must refuse with a
+    checkpoint_reset event and analyze the new corpus in full."""
+    ck = str(tmp_path / "ck")
+    with pytest.raises(InjectedKill):
+        stub_ckpt_campaign(ck, fault="kill:batch=2").run()
+    state = load_json_checkpoint(os.path.join(ck, "campaign.json"))
+    assert state["next_batch"] == 2
+    other = [(f"x{i:03d}", bytes([100 + i])) for i in range(N)]
+    res = stub_ckpt_campaign(ck, contracts=other).run()
+    assert "checkpoint_reset" in [e["kind"] for e in res.backend_events]
+    # the NEW corpus is analyzed from scratch — all N, none skipped
+    assert res.batches == 3
+    assert sorted(i["contract"] for i in res.issues) == [
+        f"x{i:03d}" for i in range(N)]
+    # the stale file was set aside as evidence, not clobbered
+    assert os.path.exists(os.path.join(ck, "campaign.json.stale"))
+
+
+def test_ckpt_legacy_three_field_shard_still_resumes(tmp_path):
+    """Pre-fingerprint checkpoints stamped [num_hosts, host_index,
+    count]; they resume when those still match (no spurious reset)."""
+    ck = str(tmp_path / "ck")
+    with pytest.raises(InjectedKill):
+        stub_ckpt_campaign(ck, fault="kill:batch=2").run()
+    p = os.path.join(ck, "campaign.json")
+    state = load_json_checkpoint(p)
+    state["shard"] = state["shard"][:3]
+    from mythril_tpu.utils.checkpoint import save_json_checkpoint
+
+    save_json_checkpoint(p, state)
+    res = stub_ckpt_campaign(ck).run()
+    assert "checkpoint_reset" not in [e["kind"]
+                                      for e in res.backend_events]
+    assert res.batches == 3     # resumed: only batch 2 replayed
+    assert sorted(i["contract"] for i in res.issues) == [
+        f"c{i:03d}" for i in range(N)]
+
+
+# --- campaign-merge CLI (typed errors + ledger dirs) -------------------
+
+
+def test_campaign_merge_cli_missing_and_malformed(tmp_path, capsys):
+    from mythril_tpu.interfaces.cli import main
+
+    rc = main(["campaign-merge", str(tmp_path / "nope.json")])
+    err = capsys.readouterr().err
+    assert rc == 2 and "nope.json" in err and err.count("\n") == 1
+
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"contracts": 3, "batches":')
+    rc = main(["campaign-merge", str(bad)])
+    err = capsys.readouterr().err
+    assert rc == 2 and "bad.json" in err and "JSON" in err
+
+    notdict = tmp_path / "list.json"
+    notdict.write_text("[1, 2]")
+    rc = main(["campaign-merge", str(notdict)])
+    err = capsys.readouterr().err
+    assert rc == 2 and "list.json" in err
+
+
+def test_campaign_merge_cli_ledger_dir_and_strict(tmp_path, capsys):
+    from mythril_tpu.interfaces.cli import main
+
+    fl = str(tmp_path / "ledger")
+    fleet_campaign(fl, None, "w0").run()
+    rc = main(["campaign-merge", "--strict-coverage", fl])
+    out = capsys.readouterr().out
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["coverage"]["full"]
+    assert payload["contracts"] == N
+
+    # knock one unit result out: coverage is no longer full and strict
+    # mode exits nonzero with the gap on stderr
+    os.unlink(os.path.join(fl, "units", "u00001.result.json"))
+    rc = main(["campaign-merge", "--strict-coverage", fl])
+    cap = capsys.readouterr()
+    assert rc == 3 and "unaccounted" in cap.err
+    assert not json.loads(cap.out)["coverage"]["full"]
+
+    # a non-ledger dir is a one-line typed error, not a traceback
+    rc = main(["campaign-merge", str(tmp_path)])
+    assert rc == 2 and "manifest" in capsys.readouterr().err

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Local multi-process fleet launcher: the CPU-testable stand-in for a
+real pod (docs/fleet.md; mirrors tools/soak_campaign.py's style).
+
+Spawns N independent worker PROCESSES (each a full
+``python -m mythril_tpu analyze --corpus ... --fleet LEDGER`` CLI run)
+against ONE shared work ledger, optionally SIGKILL-simulating some of
+them mid-batch via the PR 1 fault injector, then merges the surviving
+workers' reports with the ledger's committed unit results and prints
+the coverage verdict:
+
+    JAX_PLATFORMS=cpu python tools/fleet_campaign.py              # 2 clean workers
+    JAX_PLATFORMS=cpu python tools/fleet_campaign.py --workers 3 \\
+        --kill-worker 0@1                                         # worker 0 dies in batch 1
+    python tools/fleet_campaign.py --corpus my/corpus --fleet /nfs/ledger
+
+``--kill-worker I@J`` kills worker I at its Jth batch (1-based,
+worker-local — which GLOBAL units a worker claims is a race by design,
+so the hook uses the injector's ``kill:nth=J`` spec; InjectedKill blows
+through uncheckpointed exactly like SIGKILL, see
+mythril_tpu/resilience.py). Its leases go stale and a survivor must
+reclaim them. The merge then proves the elastic contract end to end:
+full coverage, nothing double-counted, the reclaim on the event record.
+
+Prints ONE JSON line {"ok": bool, ...} and exits 0/1 — suitable as a CI
+smoke or a manual post-change sanity run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# functional check on CPU; never touch (and possibly wedge) a real
+# accelerator from a smoke tool
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mythril_tpu.disassembler.asm import assemble  # noqa: E402
+from mythril_tpu.fleet import ledger_results  # noqa: E402
+from mythril_tpu.mythril.campaign import merge_campaigns  # noqa: E402
+
+KILLABLE = assemble(0, "SELFDESTRUCT")
+SAFE = assemble(1, 0, "SSTORE", "STOP")
+
+
+def write_corpus(d: str, n: int) -> str:
+    corpus = os.path.join(d, "corpus")
+    os.makedirs(corpus, exist_ok=True)
+    for i in range(n):
+        code = KILLABLE if i % 2 == 0 else SAFE
+        with open(os.path.join(corpus, f"c{i:03d}.hex"), "w") as fh:
+            fh.write(code.hex())
+    return corpus
+
+
+def parse_kill(spec: str) -> tuple:
+    """``I@J`` -> (worker I, batch J)."""
+    try:
+        w, b = spec.split("@", 1)
+        return int(w), int(b)
+    except ValueError:
+        raise SystemExit(f"error: --kill-worker expects I@J, got {spec!r}")
+
+
+def worker_cmd(args, corpus: str, ledger: str, i: int,
+               kills: dict) -> list:
+    cmd = [sys.executable, "-m", "mythril_tpu", "analyze",
+           "--corpus", corpus, "--fleet", ledger,
+           "--worker-id", f"w{i}",
+           "--lease-ttl", str(args.lease_ttl),
+           "--batch-size", str(args.batch_size),
+           "--lanes-per-contract", "8", "--max-steps", "64",
+           "--limits-profile", "test", "-t", "1",
+           "-m", "AccidentallyKillable", "-o", "json"]
+    if args.unit_size:
+        cmd += ["--unit-size", str(args.unit_size)]
+    if i in kills:
+        cmd += ["--fault-inject", f"kill:nth={kills[i]}"]
+    return cmd
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workers", type=int, default=2,
+                    help="worker processes to spawn (default 2)")
+    ap.add_argument("--corpus", metavar="DIR", default=None,
+                    help="corpus dir (default: generate a synthetic "
+                         "--contracts corpus in a tempdir)")
+    ap.add_argument("--contracts", type=int, default=6,
+                    help="synthetic corpus size when --corpus is not "
+                         "given (default 6; even indices killable)")
+    ap.add_argument("--fleet", metavar="DIR", default=None,
+                    help="ledger dir (default: a tempdir)")
+    ap.add_argument("--batch-size", type=int, default=2)
+    ap.add_argument("--unit-size", type=int, default=None)
+    ap.add_argument("--lease-ttl", type=float, default=3.0,
+                    help="lease TTL in seconds (default 3 — short, so "
+                         "a killed worker's units reclaim quickly)")
+    ap.add_argument("--kill-worker", action="append", default=[],
+                    metavar="I@J",
+                    help="kill worker I at its Jth batch (1-based; "
+                         "injected as kill:nth=J — repeat for several "
+                         "workers); the survivor fleet must reclaim "
+                         "and finish")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-worker wall-clock cap (default 600s)")
+    args = ap.parse_args()
+    kills = dict(parse_kill(s) for s in args.kill_worker)
+    for w in kills:
+        if not (0 <= w < args.workers):
+            ap.error(f"--kill-worker names worker {w}, but only "
+                     f"{args.workers} workers are spawned")
+
+    with tempfile.TemporaryDirectory() as d:
+        corpus = args.corpus or write_corpus(d, args.contracts)
+        ledger = args.fleet or os.path.join(d, "ledger")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        procs = []
+        for i in range(args.workers):
+            out = open(os.path.join(d, f"w{i}.json"), "w")
+            err = open(os.path.join(d, f"w{i}.log"), "w")
+            procs.append((i, subprocess.Popen(
+                worker_cmd(args, corpus, ledger, i, kills),
+                stdout=out, stderr=err, env=env), out, err))
+        workers = {}
+        reports = []
+        for i, p, out, err in procs:
+            try:
+                rc = p.wait(timeout=args.timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rc = -9
+            out.close()
+            err.close()
+            workers[f"w{i}"] = {"rc": rc, "killed": i in kills}
+            if rc == 0:
+                try:
+                    with open(os.path.join(d, f"w{i}.json")) as fh:
+                        reports.append(json.load(fh))
+                except ValueError:
+                    workers[f"w{i}"]["rc"] = "bad-json"
+            elif i not in kills:
+                # an unexpected death: show the tail so the smoke is
+                # debuggable without re-running
+                tail = open(os.path.join(d, f"w{i}.log")).read()[-800:]
+                print(f"worker {i} died rc={rc}:\n{tail}",
+                      file=sys.stderr)
+
+        # worker reports FIRST (their units win, keeping their events),
+        # the ledger LAST — it contributes exactly the units no report
+        # spoke for (e.g. a killed worker's committed units)
+        merged = merge_campaigns(reports + ledger_results(ledger))
+        cov = merged.get("coverage") or {}
+        reclaims = sum(1 for e in merged.get("backend_events", [])
+                       if e.get("kind") == "lease_reclaimed")
+        ok = bool(cov.get("full"))
+        ok &= all(w["killed"] or w["rc"] == 0 for w in workers.values())
+        if kills:
+            # a killed worker's slice must have MIGRATED, not vanished
+            ok &= reclaims > 0
+        print(json.dumps({
+            "ok": ok, "workers": workers, "coverage": cov,
+            "lease_reclaims": reclaims,
+            "issues": merged.get("issues"),
+            "contracts": merged.get("contracts"),
+        }))
+        return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

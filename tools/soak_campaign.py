@@ -33,7 +33,14 @@ Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
      landing during the background write); the pipelined resume must
      detect the tear, replay only undurable batches, converge to the
      same issue set with no contract counted twice, and leave a newest
-     checkpoint that loads cleanly.
+     checkpoint that loads cleanly;
+  8. fleet — a 2-worker in-process fleet on one work ledger
+     (docs/fleet.md): worker 0 is killed mid-batch (InjectedKill blows
+     through uncheckpointed, its lease goes stale), worker 1 must
+     RECLAIM the orphaned unit and finish the corpus; the merged
+     report (surviving worker + the ledger's committed units) must
+     show 100% analyzed+quarantined coverage, zero lost, no
+     double-counted issues, and the lease_reclaimed event on record.
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
@@ -91,7 +98,7 @@ SAFE = assemble(1, 0, "SSTORE", "STOP")
 N = 6  # even indices killable -> expected issues c000/c002/c004
 
 LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry",
-        "pipeline")
+        "pipeline", "fleet")
 
 
 def write_corpus(d: str) -> str:
@@ -322,6 +329,53 @@ def main() -> int:
                    and len(r7.issues) == 3        # nothing counted twice
                    and not r7.quarantined
                    and final.get("next_batch") == 3)
+
+        if "fleet" in want:
+            # leg 8: elastic fleet — worker 0 dies holding a lease,
+            # worker 1 reclaims after the TTL and closes coverage.
+            # batch_size=2 -> 3 one-batch units; the kill fires on
+            # whichever unit carries global batch 1, so w0 always dies
+            # holding exactly that unit's lease.
+            import time as _time
+
+            from mythril_tpu.fleet import ledger_results
+            from mythril_tpu.mythril.campaign import merge_campaigns
+
+            fl = os.path.join(d, "fleet")
+            killed = False
+            try:
+                campaign(corpus, None, "kill:batch=1", batch_size=2,
+                         fleet_dir=fl, lease_ttl=0.5,
+                         worker_id="w0").run()
+            except InjectedKill:
+                killed = True
+            _time.sleep(0.6)                  # w0's heartbeat goes stale
+            r8 = campaign(corpus, None, None, batch_size=2,
+                          fleet_dir=fl, lease_ttl=0.5,
+                          worker_id="w1").run()
+            d8 = r8.as_dict()
+            d8["issues_detail"] = r8.issues
+            # surviving worker first; the ledger contributes exactly the
+            # units no report spoke for (w0's pre-kill commits)
+            merged = merge_campaigns([d8] + ledger_results(fl))
+            cov = merged.get("coverage") or {}
+            issues = sorted(i["contract"]
+                            for i in merged.get("issues_detail", []))
+            kinds = [e.get("kind") for e in r8.backend_events]
+            legs["fleet"] = {
+                "killed": killed,
+                "reclaimed": kinds.count("lease_reclaimed"),
+                "coverage": {k: cov.get(k) for k in
+                             ("analyzed", "quarantined", "lost",
+                              "unaccounted", "full")},
+                "issues": issues,
+                "w1_units": [u["unit"] for u in r8.fleet["units"]]}
+            ok &= (killed
+                   and kinds.count("lease_reclaimed") >= 1
+                   and cov.get("full") is True
+                   and cov.get("analyzed") == N and not cov.get("lost")
+                   and merged.get("issues") == 3   # nothing twice
+                   and issues == ["c000", "c002", "c004"])
 
     print(json.dumps({"ok": bool(ok), "legs": legs}))
     return 0 if ok else 1

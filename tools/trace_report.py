@@ -10,7 +10,9 @@ Reads either output of the span tracer — the Chrome-trace JSON
   4. a checkpoint summary (saves/loads, total and worst latency),
   5. a pipeline overlap summary (device/host phase totals, stall time
      by direction, and how much host-phase time the pipelined campaign
-     hid behind device execution — docs/performance.md).
+     hid behind device execution — docs/performance.md),
+  6. a fleet summary (unit leases claimed/committed/reclaimed/lost and
+     the reclaim/lost timeline — docs/fleet.md).
 
 Usage:
     python tools/trace_report.py t.json [--top N]
@@ -213,6 +215,50 @@ def report(spans: List[Dict], instants: List[Dict], top: int = 10) -> str:
             out.append(f"batches drained to the serial path: {drained}")
     else:
         out.append("(no pipeline spans — serial run or --no-pipeline)")
+
+    # 6. fleet: lease lifecycle — how elastic the run actually was
+    # (every reclaim is a dead/wedged worker's units migrating; every
+    # lost unit is coverage the merge will flag)
+    by_kind: Dict[str, List[Dict]] = {}
+    for e in instants:
+        if e["kind"] in ("lease_claimed", "lease_reclaimed",
+                         "unit_committed", "unit_lost", "unit_duplicate"):
+            by_kind.setdefault(e["kind"], []).append(e)
+    out.append("")
+    out.append("== fleet ==")
+    if by_kind:
+        out.append(
+            f"leases claimed: {len(by_kind.get('lease_claimed', [])):>4}  "
+            f"committed: {len(by_kind.get('unit_committed', []))}  "
+            f"reclaimed: {len(by_kind.get('lease_reclaimed', []))}  "
+            f"lost: {len(by_kind.get('unit_lost', []))}  "
+            f"duplicate commits: {len(by_kind.get('unit_duplicate', []))}")
+        drama = sorted((e for k in ("lease_reclaimed", "unit_lost",
+                                    "unit_duplicate")
+                        for e in by_kind.get(k, [])),
+                       key=lambda e: e["t"])
+        if drama:
+            t0 = drama[0]["t"]
+            for e in drama:
+                a = e["args"]
+                if e["kind"] == "lease_reclaimed":
+                    out.append(
+                        f"+{e['t'] - t0:8.2f}s reclaim "
+                        f"{a.get('unit', '?')} attempt "
+                        f"{a.get('attempt', '?')} (from "
+                        f"{a.get('prev_worker', '?')}, lease age "
+                        f"{a.get('age', '?')}s)")
+                elif e["kind"] == "unit_lost":
+                    out.append(
+                        f"+{e['t'] - t0:8.2f}s LOST "
+                        f"{a.get('unit', '?')} after "
+                        f"{a.get('attempts', '?')} lease(s)")
+                else:
+                    out.append(
+                        f"+{e['t'] - t0:8.2f}s duplicate commit of "
+                        f"{a.get('unit', '?')} dropped")
+    else:
+        out.append("(no fleet events — static single/multi-host run?)")
     return "\n".join(out)
 
 
