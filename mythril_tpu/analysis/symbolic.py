@@ -256,11 +256,23 @@ class SymExecWrapper:
         enable_iprof: bool = False,
         dyn_loader=None,
         dynld_limit: int = 4,
+        warm_shapes: Optional[set] = None,
     ):
         import time as _time
 
         from ..core.frontier import CREATOR_ADDRESS
         from ..plugin.loader import LaserPluginLoader
+
+        # cross-wrapper warm-shape sharing: sym_run is one module-level
+        # jit, so its XLA cache is PROCESS-wide — a second wrapper of
+        # the same engine shape replays cached executables. A caller
+        # running many same-shape batches (CorpusCampaign, the serve
+        # scheduler) passes one set per shape class so the cold/compile
+        # accounting (engine_compiles_total, cold= span attr, deadline
+        # pacing's first-sample skip) stops re-counting warm shapes.
+        # Mutated in place by explore(); None keeps per-instance sets.
+        if warm_shapes is not None:
+            self._warm_chunk_shapes = warm_shapes
 
         self.plugin_loader = LaserPluginLoader()
         for p in plugins:

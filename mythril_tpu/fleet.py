@@ -54,7 +54,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .obs import metrics as obs_metrics
 from .obs import trace as obs_trace
-from .utils.checkpoint import fsync_dir
+from .utils.checkpoint import durable_write, fsync_dir
 
 #: on-disk manifest schema (bump on breaking layout changes; readers
 #: reject newer-than-known versions)
@@ -149,6 +149,13 @@ class WorkLedger:
         self.unit_size = 0
         self.names: List[str] = []
         self.n_units = 0
+        # feed mode (docs/serving.md): the manifest GROWS — a serve
+        # daemon appends variable-size units (each with its bytecode in
+        # a descriptor file) and eventually closes the feed; workers
+        # poll ``refresh()`` and claim through the same lease machinery
+        self.mode = "static"
+        self.unit_names_list: List[List[str]] = []
+        self.closed = False
 
     # --- events / metrics ----------------------------------------------
     def _event(self, kind: str, **kw) -> None:
@@ -174,6 +181,9 @@ class WorkLedger:
     def _lost_path(self, uid: str) -> str:
         return os.path.join(self._units_dir(), uid + ".lost")
 
+    def _unit_desc_path(self, uid: str) -> str:
+        return os.path.join(self._units_dir(), uid + ".unit.json")
+
     # --- manifest --------------------------------------------------------
     def ensure(self, contracts: Sequence[tuple], unit_size: int) -> None:
         """Create the manifest (first worker) or verify the existing one
@@ -190,6 +200,11 @@ class WorkLedger:
         p = os.path.join(self.path, _MANIFEST)
         if not _exclusive_write(p, json.dumps(doc, sort_keys=True).encode()):
             have = self._read_manifest(p)
+            if have.get("mode") == "feed":
+                raise ValueError(
+                    f"fleet ledger {self.path} is a FEED ledger (a "
+                    "serve daemon appends its units); workers join it "
+                    "with --fleet-follow, not with a local corpus")
             if (have.get("corpus") != fp
                     or int(have.get("unit_size", 0)) != unit_size
                     or have.get("names") != names):
@@ -201,21 +216,30 @@ class WorkLedger:
                     f"{unit_size}); point every worker at the same "
                     "corpus or use a fresh ledger dir")
             doc = have
-        self.corpus = str(doc["corpus"])
-        self.unit_size = int(doc["unit_size"])
-        self.names = list(doc["names"])
-        self.n_units = int(doc["units"])
+        self._apply_manifest(doc)
+
+    def _apply_manifest(self, doc: Dict) -> None:
+        self.mode = str(doc.get("mode", "static"))
+        self.corpus = str(doc.get("corpus", ""))
+        self.names = list(doc.get("names") or [])
+        self.closed = bool(doc.get("closed", False))
+        if self.mode == "feed":
+            self.unit_size = 0
+            self.unit_names_list = [list(u) for u
+                                    in (doc.get("unit_names") or [])]
+            self.n_units = int(doc.get("units")
+                               or len(self.unit_names_list))
+        else:
+            self.unit_size = max(1, int(doc.get("unit_size", 1)))
+            self.n_units = int(doc.get("units")
+                               or (len(self.names) + self.unit_size - 1)
+                               // self.unit_size)
 
     def load_manifest(self) -> None:
         """Attach to an existing ledger (merge/tools path — no corpus in
         hand to verify against)."""
-        doc = self._read_manifest(os.path.join(self.path, _MANIFEST))
-        self.corpus = str(doc.get("corpus", ""))
-        self.unit_size = max(1, int(doc.get("unit_size", 1)))
-        self.names = list(doc.get("names") or [])
-        self.n_units = int(doc.get("units")
-                           or (len(self.names) + self.unit_size - 1)
-                           // self.unit_size)
+        self._apply_manifest(
+            self._read_manifest(os.path.join(self.path, _MANIFEST)))
 
     def _read_manifest(self, p: str) -> Dict:
         try:
@@ -238,13 +262,153 @@ class WorkLedger:
     def manifest_summary(self) -> Dict:
         """The manifest as embedded in a worker's report ``fleet``
         section — what ``merge_campaigns`` needs for the coverage
-        manifest (unit→contracts is rebuilt from names + unit_size)."""
-        return {"corpus": self.corpus, "unit_size": self.unit_size,
-                "units": self.n_units, "names": list(self.names)}
+        manifest (unit→contracts is rebuilt from names + unit_size for
+        static ledgers, from the per-unit name lists for feeds)."""
+        out = {"corpus": self.corpus, "unit_size": self.unit_size,
+               "units": self.n_units, "names": list(self.names)}
+        if self.mode == "feed":
+            out["mode"] = "feed"
+            out["unit_names"] = [list(u) for u in self.unit_names_list]
+        return out
 
     def unit_names(self, index: int) -> List[str]:
+        if self.mode == "feed":
+            return (list(self.unit_names_list[index])
+                    if index < len(self.unit_names_list) else [])
         s = index * self.unit_size
         return self.names[s:s + self.unit_size]
+
+    def unit_start(self, index: int) -> int:
+        """Offset of the unit's first contract in manifest order — the
+        worker's GLOBAL batch-index base. Feed units are variable-size,
+        so the offset is a prefix sum over the fed name lists."""
+        if self.mode == "feed":
+            return sum(len(u) for u in self.unit_names_list[:index])
+        return index * self.unit_size
+
+    # --- feed mode (docs/serving.md) -------------------------------------
+    def ensure_feed(self) -> None:
+        """Create (or re-attach to) a FEED ledger: the manifest starts
+        empty and grows one unit at a time via :meth:`feed_unit`. The
+        feeder (a serve daemon) is the SOLE manifest writer — workers
+        only read it (``refresh``) and claim/commit through the usual
+        lease files, so the single-writer manifest needs no lock."""
+        os.makedirs(self._units_dir(), exist_ok=True)
+        doc = {"schema": LEDGER_SCHEMA, "mode": "feed", "corpus": "feed",
+               "unit_size": 0, "names": [], "unit_names": [],
+               "units": 0, "closed": False}
+        p = os.path.join(self.path, _MANIFEST)
+        if not _exclusive_write(p, json.dumps(doc,
+                                              sort_keys=True).encode()):
+            have = self._read_manifest(p)
+            if have.get("mode") != "feed":
+                raise ValueError(
+                    f"fleet ledger {self.path} holds a static corpus "
+                    "manifest; a serve daemon needs a fresh (or feed) "
+                    "ledger dir")
+            doc = have
+            # a restarted daemon re-opens its own feed: committed units
+            # stay committed (restart serves them from the ledger), new
+            # submissions append after them
+            if doc.get("closed"):
+                doc["closed"] = False
+                self._write_manifest(doc)
+        self._apply_manifest(doc)
+
+    def attach_feed(self) -> None:
+        """Worker-side join of a feed ledger (``--fleet-follow``)."""
+        self.load_manifest()
+        if self.mode != "feed":
+            raise ValueError(
+                f"{self.path}: not a feed ledger (manifest mode "
+                f"{self.mode!r}); --fleet-follow joins a serve "
+                "daemon's ledger — for a static corpus use --fleet "
+                "with --corpus")
+
+    def refresh(self) -> None:
+        """Re-read a feed manifest (atomic rewrite on the feeder side
+        means readers see the old or the new doc, never a torn one). A
+        transiently unreadable manifest keeps the last good view."""
+        try:
+            self._apply_manifest(
+                self._read_manifest(os.path.join(self.path, _MANIFEST)))
+        except ValueError:
+            pass
+
+    def _write_manifest(self, doc: Dict) -> None:
+        durable_write(os.path.join(self.path, _MANIFEST),
+                      json.dumps(doc, sort_keys=True).encode())
+
+    def _manifest_doc(self) -> Dict:
+        return {"schema": LEDGER_SCHEMA, "mode": "feed", "corpus": "feed",
+                "unit_size": 0, "names": list(self.names),
+                "unit_names": [list(u) for u in self.unit_names_list],
+                "units": self.n_units, "closed": self.closed}
+
+    def feed_unit(self, contracts: Sequence[tuple],
+                  config: Optional[Dict] = None) -> str:
+        """Append one work unit of ``(name, bytecode)`` pairs. The unit
+        DESCRIPTOR (names + bytecode hex + analysis config) lands
+        durably BEFORE the manifest's unit count exposes it, so a
+        worker can never claim a unit whose bytecode is not yet
+        readable. Returns the unit id."""
+        if self.mode != "feed":
+            raise ValueError("feed_unit() on a static ledger")
+        index = self.n_units
+        uid = self.uid(index)
+        names = [str(n) for n, _ in contracts]
+        desc = {"unit": uid, "names": names,
+                "codes": [bytes(c).hex() for _, c in contracts],
+                "config": dict(config or {}),
+                "t": round(time.time(), 3)}
+        if not _exclusive_write(self._unit_desc_path(uid),
+                                json.dumps(desc, sort_keys=True).encode()):
+            raise ValueError(
+                f"{self.path}: unit descriptor {uid} already exists — "
+                "two feeders on one ledger?")
+        self.unit_names_list.append(names)
+        self.names.extend(names)
+        self.n_units = index + 1
+        self._write_manifest(self._manifest_doc())
+        obs_metrics.REGISTRY.counter(
+            "fleet_units_fed_total",
+            help="work units appended to feed ledgers").inc()
+        self._event("unit_fed", unit=uid, contracts=len(names))
+        return uid
+
+    def feed_close(self) -> None:
+        """Mark the feed complete: workers drain what is claimable and
+        exit instead of polling forever."""
+        if self.mode != "feed" or self.closed:
+            return
+        self.closed = True
+        self._write_manifest(self._manifest_doc())
+        self._event("feed_closed", units=self.n_units)
+
+    def feed_closed(self) -> bool:
+        return self.closed
+
+    def read_unit(self, uid: str) -> Tuple[List[str], List[bytes], Dict]:
+        """A fed unit's ``(names, bytecodes, config)`` from its
+        descriptor file."""
+        with open(self._unit_desc_path(uid)) as fh:
+            doc = json.load(fh)
+        return ([str(n) for n in doc.get("names") or []],
+                [bytes.fromhex(c) for c in doc.get("codes") or []],
+                dict(doc.get("config") or {}))
+
+    def result_record(self, uid: str) -> Optional[Dict]:
+        """The committed result of one unit, or None while pending /
+        unreadable (a torn read retries on the next poll)."""
+        try:
+            with open(self._result_path(uid)) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def unit_lost(self, uid: str) -> bool:
+        return (os.path.exists(self._lost_path(uid))
+                and not os.path.exists(self._result_path(uid)))
 
     # --- claim / reclaim -------------------------------------------------
     def _scan_order(self) -> range:
@@ -275,7 +439,7 @@ class WorkLedger:
             help="work-unit leases granted to this process").inc()
         self._event("lease_claimed", unit=uid, attempt=attempt)
         return WorkUnit(uid=uid, index=index,
-                        start=index * self.unit_size,
+                        start=self.unit_start(index),
                         names=self.unit_names(index), attempt=attempt)
 
     def _try_reclaim(self, index: int, age: float) -> Optional[WorkUnit]:

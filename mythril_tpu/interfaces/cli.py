@@ -223,6 +223,13 @@ def create_parser() -> argparse.ArgumentParser:
                    help="fleet mode: stable worker identity stamped "
                         "into leases and unit results (default: "
                         "hostname-pid-tid)")
+    a.add_argument("--fleet-follow", action="store_true",
+                   help="fleet mode: join a serve daemon's FEED ledger "
+                        "(docs/serving.md) — units carry their own "
+                        "bytecode, so no --corpus is needed; the "
+                        "worker polls for newly fed units and exits "
+                        "when the feeder closes the feed (or "
+                        "--execution-timeout lapses)")
     a.add_argument("--num-hosts", type=int, default=0, metavar="N",
                    help="campaign mode: shard the corpus across N hosts; "
                         "this process analyzes slice --host-index "
@@ -300,6 +307,82 @@ def create_parser() -> argparse.ArgumentParser:
                     help="exit nonzero unless the merged coverage "
                          "manifest is full (every contract analyzed or "
                          "quarantined — nothing lost or unaccounted)")
+
+    sv = sub.add_parser(
+        "serve",
+        help="always-on analysis daemon: admission queue, bytecode-"
+             "hash dedupe, warm-compile reuse, streaming results "
+             "(docs/serving.md)")
+    sv.add_argument("--host", default="127.0.0.1",
+                    help="bind address (default 127.0.0.1)")
+    sv.add_argument("--port", type=int, default=8780,
+                    help="bind port; 0 asks the OS for a free one "
+                         "(see --port-file)")
+    sv.add_argument("--port-file", metavar="PATH",
+                    help="write the BOUND port to PATH once listening "
+                         "(the --port 0 discovery channel for "
+                         "supervisors and tests)")
+    sv.add_argument("--data-dir", default="serve_data", metavar="DIR",
+                    help="persistent serve state (the dedupe verdict "
+                         "store lives in DIR/store); survives "
+                         "restarts — that is the exactly-once story")
+    sv.add_argument("--no-dedupe", dest="dedupe", action="store_false",
+                    default=True,
+                    help="escape hatch: always re-analyze, never "
+                         "serve or write stored verdicts")
+    sv.add_argument("--max-queue", type=int, default=4096, metavar="N",
+                    help="admission queue depth bound; overflow gets "
+                         "HTTP 429 (default 4096)")
+    sv.add_argument("--drain-timeout", type=float, default=30.0,
+                    metavar="SEC",
+                    help="SIGTERM drain budget: how long the in-flight "
+                         "batch (or fed fleet units) may take before "
+                         "the daemon abandons them and exits "
+                         "(default 30)")
+    sv.add_argument("--fleet", metavar="DIR",
+                    help="front a multi-host fleet: append admitted "
+                         "batches to a FEED work ledger in DIR instead "
+                         "of running locally; workers join with "
+                         "'analyze --fleet DIR --fleet-follow' "
+                         "(docs/fleet.md, docs/serving.md)")
+    sv.add_argument("--batch-size", type=int, default=8,
+                    help="contracts per compiled service batch "
+                         "(default 8)")
+    sv.add_argument("--lanes-per-contract", type=int, default=32)
+    sv.add_argument("--max-steps", type=int, default=256,
+                    help="default superstep budget per transaction "
+                         "(overridable per request)")
+    sv.add_argument("-t", "--transaction-count", type=int, default=1,
+                    help="default attacker transactions (overridable "
+                         "per request)")
+    sv.add_argument("-m", "--modules", metavar="LIST",
+                    help="default detection-module allow list "
+                         "(overridable per request)")
+    sv.add_argument("--limits-profile", choices=["default", "test"],
+                    default="default")
+    sv.add_argument("--solver-iters", type=int, default=400)
+    sv.add_argument("--solver-timeout", type=int, default=None,
+                    metavar="MS")
+    sv.add_argument("--solver-workers", type=int, default=1, metavar="N")
+    sv.add_argument("--batch-timeout", type=float, default=None,
+                    metavar="SEC",
+                    help="per-batch watchdog (same contract as "
+                         "campaign mode)")
+    sv.add_argument("--max-batch-retries", type=int, default=1,
+                    metavar="N")
+    sv.add_argument("--oom-ladder", metavar="LIST", default=None)
+    sv.add_argument("--fault-inject", metavar="SPEC",
+                    help="testing: deterministic faults in service "
+                         "batches (batch indices count monotonically "
+                         "over the daemon lifetime)")
+    sv.add_argument("--concrete-storage", action="store_true")
+    sv.add_argument("--trace", metavar="FILE",
+                    help="Chrome-trace + JSONL event log (admit/"
+                         "queue_wait/schedule/stream spans ride the "
+                         "same spine as batch spans)")
+    sv.add_argument("--metrics", metavar="FILE",
+                    help="metrics snapshot at exit (the live registry "
+                         "is always scrapeable at /metrics)")
 
     ld = sub.add_parser("list-detectors",
                         help="list registered detection modules")
@@ -455,9 +538,16 @@ def exec_analyze(args) -> int:
 def _exec_analyze_inner(args) -> int:
     # campaign mode dispatches BEFORE any engine import: --init-timeout
     # must be able to probe (and fall back from) a wedged backend while
-    # this process is still backend-free
-    if getattr(args, "corpus", None):
+    # this process is still backend-free. --fleet-follow is a campaign
+    # with no local corpus (the feed ledger supplies the bytecode).
+    if getattr(args, "corpus", None) or (
+            getattr(args, "fleet", None)
+            and getattr(args, "fleet_follow", False)):
         return _exec_campaign(args)
+    if getattr(args, "fleet_follow", False):
+        print("error: --fleet-follow requires --fleet DIR",
+              file=sys.stderr)
+        raise SystemExit(2)
 
     import dataclasses
 
@@ -640,7 +730,13 @@ def _exec_campaign(args) -> int:
         if val is not None:
             print(f"warning: {flag} has no effect in campaign mode",
                   file=sys.stderr)
-    contracts = load_corpus_dir(args.corpus)
+    fleet_follow = getattr(args, "fleet_follow", False)
+    if fleet_follow and args.corpus:
+        print("error: --fleet-follow takes its contracts from the feed "
+              "ledger; drop --corpus (or drop --fleet-follow for a "
+              "static fleet)", file=sys.stderr)
+        raise SystemExit(2)
+    contracts = [] if fleet_follow else load_corpus_dir(args.corpus)
     if args.fleet:
         # the ledger IS the work distribution: every worker sees the
         # whole corpus and claims leased units (docs/fleet.md); a
@@ -690,6 +786,7 @@ def _exec_campaign(args) -> int:
         unit_size=args.unit_size,
         max_unit_leases=args.max_unit_leases,
         worker_id=args.worker_id,
+        fleet_follow=fleet_follow,
     )
 
     unit_word = "unit" if args.fleet else "batch"
@@ -703,6 +800,72 @@ def _exec_campaign(args) -> int:
     if args.outform in ("json", "jsonv2"):
         out["issues_detail"] = res.issues
     print(json.dumps(out, indent=1))
+    return 0
+
+
+def exec_serve(args) -> int:
+    """Always-on analysis daemon (docs/serving.md): admission queue +
+    bytecode-hash dedupe + warm-compile reuse + streaming results over
+    a thin stdlib HTTP surface. Blocks until SIGTERM/SIGINT completes
+    the graceful drain."""
+    from ..obs import metrics as obs_metrics
+    from ..obs import trace as obs_trace
+    from ..resilience import parse_ladder
+    from ..serve import AnalysisDaemon, ServeOptions
+
+    try:
+        oom_ladder = parse_ladder(args.oom_ladder)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    if args.trace:
+        obs_trace.configure(args.trace)
+    opts = ServeOptions(
+        batch_size=args.batch_size,
+        lanes_per_contract=args.lanes_per_contract,
+        max_steps=args.max_steps,
+        transaction_count=args.transaction_count,
+        modules=args.modules.split(",") if args.modules else None,
+        limits_profile=args.limits_profile,
+        solver_iters=args.solver_iters,
+        solver_timeout=(args.solver_timeout / 1000.0
+                        if args.solver_timeout is not None else None),
+        solver_workers=args.solver_workers,
+        batch_timeout=args.batch_timeout,
+        max_batch_retries=args.max_batch_retries,
+        oom_ladder=oom_ladder,
+        fault_inject=args.fault_inject,
+        concrete_storage=args.concrete_storage,
+    )
+    daemon = AnalysisDaemon(
+        opts, data_dir=args.data_dir, host=args.host, port=args.port,
+        dedupe=args.dedupe, max_queue=args.max_queue,
+        drain_timeout=args.drain_timeout, fleet_dir=args.fleet)
+    daemon.install_signal_handlers()
+    try:
+        daemon.start()
+        print(f"serving on {daemon.host}:{daemon.port} "
+              f"(data dir {args.data_dir}"
+              + (f", fleet feed {args.fleet}" if args.fleet else "")
+              + ")", file=sys.stderr, flush=True)
+        if args.port_file:
+            with open(args.port_file, "w") as fh:
+                fh.write(str(daemon.port))
+        daemon.wait_stopped()
+    finally:
+        daemon.shutdown("exit")
+        if args.trace:
+            try:
+                obs_trace.close()
+            except Exception as exc:  # noqa: BLE001 — never mask exit
+                print(f"warning: trace write failed: {exc}",
+                      file=sys.stderr)
+        if args.metrics:
+            try:
+                obs_metrics.REGISTRY.write(args.metrics)
+            except Exception as exc:  # noqa: BLE001
+                print(f"warning: metrics write failed: {exc}",
+                      file=sys.stderr)
     return 0
 
 
@@ -931,6 +1094,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return exec_safe_functions(args)
     if args.command == "campaign-merge":
         return exec_campaign_merge(args)
+    if args.command == "serve":
+        return exec_serve(args)
     if args.command == "list-detectors":
         return exec_list_detectors(args)
     if args.command == "version":

@@ -203,6 +203,7 @@ class CorpusCampaign:
         unit_size: Optional[int] = None,
         max_unit_leases: int = 3,
         worker_id: Optional[str] = None,
+        fleet_follow: bool = False,
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -321,6 +322,18 @@ class CorpusCampaign:
         us = unit_size if unit_size else batch_size
         self.unit_size = ((max(1, int(us)) + batch_size - 1)
                           // batch_size) * batch_size
+        # follow mode (docs/serving.md): with fleet_follow the ledger is
+        # a FEED — units (with their bytecode) arrive over time from a
+        # serve daemon instead of being cut from a local corpus
+        self.fleet_follow = bool(fleet_follow)
+        # cross-batch warm-compile accounting: one chunk-shape set per
+        # ENGINE shape class (batch width, lanes, step budget, tx
+        # count), shared by every SymExecWrapper of that class — batch
+        # N>0 of a campaign (or request N>0 of a serve daemon) rides
+        # sym_run's process-wide XLA cache, and with a shared set the
+        # compile counter / cold spans / pacing stop re-counting it
+        self._warm_shapes: Dict[tuple, set] = {}
+        self._extern_batches = 0
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -484,6 +497,7 @@ class CorpusCampaign:
         from ..analysis import SymExecWrapper
 
         width = self.batch_size if width is None else width
+        lanes = self.lanes_per_contract if lanes is None else lanes
         names = list(names)
         codes = list(codes)
         # constant compiled shape: pad short batches with STOP stubs
@@ -493,15 +507,38 @@ class CorpusCampaign:
         return SymExecWrapper(
             codes, contract_names=names, limits=self.limits,
             spec=self.spec,
-            lanes_per_contract=(self.lanes_per_contract
-                                if lanes is None else lanes),
+            lanes_per_contract=lanes,
             max_steps=self.max_steps,
             solver_iters=self.solver_iters,
             solver_timeout=self.solver_timeout,
             transaction_count=self.transaction_count,
             plugins=self.plugins,
             enable_iprof=self.enable_iprof,
+            warm_shapes=self._warm_set(lanes, width),
         )
+
+    def _shape_key(self, lanes: Optional[int] = None,
+                   width: Optional[int] = None) -> tuple:
+        """Identity of one compiled engine shape class: every batch with
+        this key replays the same sym_run executables (the corpus is
+        padded to ``width`` contracts x ``lanes`` lanes, and max_steps /
+        transaction_count are static jit args). Degrade rungs shrink
+        lanes/width and thus land in their own (cheaper) class."""
+        return (self.batch_size if width is None else width,
+                self.lanes_per_contract if lanes is None else lanes,
+                self.max_steps, self.transaction_count)
+
+    def _warm_set(self, lanes: Optional[int] = None,
+                  width: Optional[int] = None) -> set:
+        return self._warm_shapes.setdefault(self._shape_key(lanes, width),
+                                            set())
+
+    def shape_is_warm(self, lanes: Optional[int] = None,
+                      width: Optional[int] = None) -> bool:
+        """Whether this campaign has already compiled (some chunk of)
+        the given engine shape class — the serve scheduler's
+        warm-compile-hit predicate (docs/serving.md)."""
+        return bool(self._warm_shapes.get(self._shape_key(lanes, width)))
 
     def _harvest_batch(self, bi: int, sym) -> Dict:
         """HOST phase of one batch: detection modules + witness search +
@@ -540,6 +577,47 @@ class CorpusCampaign:
         sub-batches."""
         return self._harvest_batch(
             bi, self._explore_batch(bi, names, codes, lanes, width))
+
+    # --- resident mode (docs/serving.md) --------------------------------
+    def run_external_batch(self, items: Sequence[tuple],
+                           bi: Optional[int] = None) -> Dict:
+        """Resident-mode entry: analyze one externally-fed batch of
+        ``(name, bytecode)`` pairs through the FULL resilient machinery
+        (watchdog / OOM ladder / retry / bisect-to-quarantine) and
+        return its partial-result dict (``issues`` / ``paths`` /
+        ``dropped`` / ``iprof`` / ``quarantined`` / ``retries`` /
+        ``status``).
+
+        This is what turns the batch campaign into a service substrate
+        (ROADMAP open item #3): the serve scheduler keeps ONE campaign
+        instance per engine shape class alive across requests, so every
+        batch after the first replays sym_run's cached executables (the
+        shared warm-shape set keeps the compile accounting honest) and
+        nothing recompiles on entry. No checkpoint is written — the
+        caller owns durability (the serve results store; a fleet feed
+        ledger commits per unit). Batch indices default to a private
+        monotone counter so fault specs (``raise:batch=N``) and trace
+        correlation keep meaning one thing for the daemon's lifetime."""
+        if bi is None:
+            bi = self._extern_batches
+        self._extern_batches = max(self._extern_batches, bi) + 1
+        items = list(items)
+        with obs_trace.timer("batch", bi=bi, n=len(items),
+                             resident=True) as sp:
+            out = self._run_batch_resilient(bi, items)
+        self._emit_backend_events()
+        obs_trace.event("batch_status", bi=bi, status=out["status"],
+                        dur=round(sp.elapsed, 6))
+        reg = obs_metrics.REGISTRY
+        reg.counter("batches_total").inc()
+        reg.histogram("batch_seconds",
+                      help="per-batch wall time").observe(sp.elapsed)
+        reg.counter("batch_retries_total").inc(out["retries"])
+        reg.counter("contracts_quarantined_total").inc(
+            len(out["quarantined"]))
+        out["wall_sec"] = sp.elapsed
+        out["batch"] = bi
+        return out
 
     # --- fault isolation ----------------------------------------------
     @staticmethod
@@ -982,7 +1060,9 @@ class CorpusCampaign:
 
     # --- elastic fleet mode (docs/fleet.md) -----------------------------
     def _run_unit(self, ledger, unit,
-                  deadline: Optional[float] = None) -> Optional[Dict]:
+                  deadline: Optional[float] = None,
+                  items: Optional[Sequence[tuple]] = None
+                  ) -> Optional[Dict]:
         """Analyze one claimed work unit: its contracts stream through
         the same resilient batch machinery as a static run (retry /
         degrade / bisect / quarantine all apply within the unit), under
@@ -1003,7 +1083,11 @@ class CorpusCampaign:
                      "issues": [], "paths_total": 0, "dropped_forks": 0,
                      "batches": 0, "batch_wall": [], "batch_status": [],
                      "quarantined": [], "retries": 0, "iprof": {}}
-        items = self.contracts[unit.start:unit.start + len(unit.names)]
+        # static ledgers index the local corpus; feed units (follow
+        # mode) carry their own bytecode — the caller hands it in
+        items = (list(items) if items is not None
+                 else self.contracts[unit.start:unit.start
+                                     + len(unit.names)])
         base_bi = unit.start // self.batch_size
         reg = obs_metrics.REGISTRY
         with ledger.renewer(unit):
@@ -1045,6 +1129,37 @@ class CorpusCampaign:
                          for k, v in SOLVER_STATS.delta(stats0).items()}
         return rec
 
+    def _fleet_absorb(self, res: CampaignResult, rec: Dict) -> None:
+        """Fold one committed unit record into this worker's result."""
+        res.issues.extend(rec["issues"])
+        res.paths_total += rec["paths_total"]
+        res.dropped_forks += rec["dropped_forks"]
+        res.batch_wall.extend(rec["batch_wall"])
+        res.batch_status.extend(rec["batch_status"])
+        res.quarantined.extend(rec["quarantined"])
+        res.retries += rec["retries"]
+        for k, v in rec["iprof"].items():
+            res.iprof[k] = res.iprof.get(k, 0) + v
+        res.fleet["units"].append(rec)
+
+    def _fleet_beat(self, res: CampaignResult, rec: Dict) -> None:
+        if self.heartbeat_every is None:
+            return
+        now = time.monotonic()
+        if (self._last_beat is not None
+                and now - self._last_beat < self.heartbeat_every):
+            return
+        self._last_beat = now
+        wall = sum(res.batch_wall)
+        pps = res.paths_total / wall if wall else 0.0
+        print(f"heartbeat: unit {rec['unit']} committed "
+              f"({len(res.fleet['units'])} by this worker), "
+              f"paths/s {pps:.1f}",
+              file=sys.stderr, flush=True)
+        obs_trace.event("heartbeat", unit=rec["unit"],
+                        units_committed=len(res.fleet["units"]),
+                        paths_per_sec=round(pps, 1))
+
     def _run_fleet(self, progress=None) -> CampaignResult:
         """Claim→run→commit loop against the shared work ledger
         (docs/fleet.md). Durability is the per-unit result files — the
@@ -1055,7 +1170,14 @@ class CorpusCampaign:
         ready to reclaim if their heartbeats go stale. An
         ``InjectedKill`` (or real signal) blows through uncommitted,
         leaving our lease to expire — exactly the contract the
-        reclaim path is built on."""
+        reclaim path is built on.
+
+        With ``fleet_follow`` the ledger is a FEED (docs/serving.md): a
+        serve daemon appends units — each carrying its own bytecode —
+        over time, so instead of cutting the local corpus this worker
+        polls for newly fed units and exits only when the feeder has
+        CLOSED the feed and every unit is committed or lost (or the
+        ``execution_timeout`` deadline lapses)."""
         from ..fleet import WorkLedger
         from ..smt.solver import SOLVER_STATS
 
@@ -1066,7 +1188,10 @@ class CorpusCampaign:
         ledger = WorkLedger(self.fleet_dir, ttl=self.lease_ttl,
                             max_leases=self.max_unit_leases,
                             worker=self.worker_id, on_event=self._event)
-        ledger.ensure(self.contracts, unit_size=self.unit_size)
+        if self.fleet_follow:
+            ledger.attach_feed()
+        else:
+            ledger.ensure(self.contracts, unit_size=self.unit_size)
         res = CampaignResult()
         res.fleet = {"worker": ledger.worker,
                      "manifest": ledger.manifest_summary(),
@@ -1076,48 +1201,40 @@ class CorpusCampaign:
         while True:
             if deadline is not None and time.monotonic() >= deadline:
                 break
+            if self.fleet_follow:
+                ledger.refresh()
             unit = ledger.claim_next()
             if unit is None:
-                if not ledger.pending():
+                if self.fleet_follow:
+                    if ledger.feed_closed() and not ledger.pending():
+                        break
+                elif not ledger.pending():
                     break
-                # someone else holds live leases: poll — their units
-                # become reclaimable the moment the heartbeats go stale
+                # someone else holds live leases (or the feeder has
+                # more work coming): poll — stale heartbeats become
+                # reclaimable, fed units become claimable
                 time.sleep(poll)
                 continue
-            rec = self._run_unit(ledger, unit, deadline)
+            items = None
+            if self.fleet_follow:
+                unames, codes, _cfg = ledger.read_unit(unit.uid)
+                items = list(zip(unames, codes))
+            rec = self._run_unit(ledger, unit, deadline, items=items)
             if rec is None:
                 break  # deadline mid-unit; lease already released
             if ledger.commit(unit, rec):
-                res.issues.extend(rec["issues"])
-                res.paths_total += rec["paths_total"]
-                res.dropped_forks += rec["dropped_forks"]
-                res.batch_wall.extend(rec["batch_wall"])
-                res.batch_status.extend(rec["batch_status"])
-                res.quarantined.extend(rec["quarantined"])
-                res.retries += rec["retries"]
-                for k, v in rec["iprof"].items():
-                    res.iprof[k] = res.iprof.get(k, 0) + v
-                res.fleet["units"].append(rec)
+                self._fleet_absorb(res, rec)
+                # the manifest in the report must cover the units this
+                # worker saw — a feed manifest grows after attach
+                if self.fleet_follow:
+                    res.fleet["manifest"] = ledger.manifest_summary()
             # a failed commit (duplicate) already landed its event via
             # the ledger; the record is DROPPED so nothing counts twice
             done_units += 1
             if progress is not None:
                 progress(done_units, ledger.n_units,
                          sum(rec["batch_wall"]), len(res.issues))
-            if self.heartbeat_every is not None:
-                now = time.monotonic()
-                if (self._last_beat is None
-                        or now - self._last_beat >= self.heartbeat_every):
-                    self._last_beat = now
-                    wall = sum(res.batch_wall)
-                    pps = res.paths_total / wall if wall else 0.0
-                    print(f"heartbeat: unit {rec['unit']} committed "
-                          f"({len(res.fleet['units'])} by this worker), "
-                          f"paths/s {pps:.1f}",
-                          file=sys.stderr, flush=True)
-                    obs_trace.event("heartbeat", unit=rec["unit"],
-                                    units_committed=len(res.fleet["units"]),
-                                    paths_per_sec=round(pps, 1))
+            self._fleet_beat(res, rec)
         res.fleet["lost"] = ledger.lost_units()
         res.batches = len(res.batch_wall)
         res.contracts = sum(len(u["contracts"])
@@ -1438,13 +1555,28 @@ def _fleet_coverage(manifests: Sequence[Dict], unit_rows: Sequence[Dict],
     ``unaccounted`` is whatever no record speaks for (a worker's result
     file missing from the merge, a unit still leased when the fleet
     stopped, a corrupt unit result)."""
-    man = manifests[0]
-    mixed = any(m.get("corpus") != man.get("corpus")
-                or m.get("names") != man.get("names")
-                for m in manifests[1:])
+    # a FEED manifest (docs/serving.md) grows while workers run, so
+    # snapshots taken at different commit times legitimately differ in
+    # length: take the largest as truth and call it mixed only when an
+    # earlier snapshot is not a prefix of it. Static manifests must
+    # match exactly, as before.
+    man = max(manifests, key=lambda m: int(m.get("units") or 0))
     names = list(man.get("names") or [])
+    if any(m.get("mode") == "feed" for m in manifests):
+        mixed = any(
+            m.get("corpus") != man.get("corpus")
+            or list(m.get("names") or []) != names[:len(m.get("names")
+                                                        or [])]
+            for m in manifests)
+    else:
+        mixed = any(m.get("corpus") != man.get("corpus")
+                    or m.get("names") != man.get("names")
+                    for m in manifests)
     us = max(1, int(man.get("unit_size") or 1))
     n_units = int(man.get("units") or (len(names) + us - 1) // us)
+    # feed units are variable-size: the manifest carries the per-unit
+    # name lists instead of a fixed unit_size stride
+    unit_names_list = man.get("unit_names")
     committed = {str(u.get("unit")): u for u in unit_rows}
     lost_ids: Dict[str, Dict] = {}
     for r, fresh in kept:
@@ -1458,7 +1590,11 @@ def _fleet_coverage(manifests: Sequence[Dict], unit_rows: Sequence[Dict],
     unacc_units: List[str] = []
     for k in range(n_units):
         uid = f"u{k:05d}"
-        unames = names[k * us:(k + 1) * us]
+        if unit_names_list is not None:
+            unames = list(unit_names_list[k]) \
+                if k < len(unit_names_list) else []
+        else:
+            unames = names[k * us:(k + 1) * us]
         if not unames:
             break
         if uid in committed:

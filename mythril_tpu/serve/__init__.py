@@ -1,0 +1,47 @@
+"""Always-on analysis service (docs/serving.md, ROADMAP open item #3).
+
+Everything below this package is batch-shaped: one ``analyze`` /
+campaign per process, recompiling the superstep on entry. Serving heavy
+traffic needs the opposite — a RESIDENT daemon that amortizes the two
+big per-request costs across every request it will ever take:
+
+- XLA compilation: the scheduler keeps one :class:`CorpusCampaign` per
+  engine shape class alive for the process lifetime, so request N>0 of
+  a shape replays ``sym_run``'s cached executables instead of paying a
+  cold compile (``serve_warm_compile_hits_total``);
+- solver + lane work on duplicate bytecode: mainnet is dominated by
+  proxy/clone bytecode, so the admission queue dedupes by
+  ``(bytecode_hash, config_hash)`` BEFORE anything reaches a lane —
+  against the persistent results store and against in-flight work
+  (``serve_dedupe_hits_total``).
+
+The pieces:
+
+- :mod:`serve.store` — durable per-contract verdict store (the first
+  slice of ROADMAP's cross-campaign verdict store);
+- :mod:`serve.queue` — admission queue: dedupe, per-tenant priority +
+  deadline ordering, deadline eviction, bounded depth;
+- :mod:`serve.scheduler` — drains the queue into resident campaigns
+  (or a fleet FEED ledger fronting remote workers, docs/fleet.md);
+- :mod:`serve.http` — thin stdlib HTTP surface (`POST /v1/submit`,
+  long-poll / chunked-streaming `GET /v1/result/<id>`, `/healthz`,
+  Prometheus `/metrics`);
+- :mod:`serve.daemon` — lifecycle: wiring, signal handling, graceful
+  drain (SIGTERM finishes the in-flight batch, persists its verdicts,
+  rejects new submissions with 503, then exits — a restart serves the
+  finished work from the store, exactly once).
+
+Import cost is stdlib-only until the first batch actually runs (the
+engine loads lazily inside the scheduler), mirroring the campaign CLI's
+backend-free front door.
+"""
+
+from .daemon import AnalysisDaemon, ServeOptions
+from .queue import (AdmissionQueue, Entry, QueueClosed, QueueFull,
+                    Submission)
+from .scheduler import Scheduler
+from .store import ResultsStore, bytecode_hash, config_hash
+
+__all__ = ["AdmissionQueue", "AnalysisDaemon", "Entry", "QueueClosed",
+           "QueueFull", "ResultsStore", "Scheduler", "ServeOptions",
+           "Submission", "bytecode_hash", "config_hash"]

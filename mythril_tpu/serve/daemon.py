@@ -1,0 +1,239 @@
+"""Daemon lifecycle: wiring, signal handling, graceful drain.
+
+:class:`AnalysisDaemon` composes the serve layer (docs/serving.md):
+one results store, one admission queue, one scheduler thread, one
+threaded HTTP server. The lifecycle contract:
+
+- **start** — scheduler + HTTP come up; the engine itself loads lazily
+  on the first batch that actually needs lanes, so a daemon fronting a
+  pure-dedupe workload never initializes a backend;
+- **SIGTERM / SIGINT** (or :meth:`shutdown`) — DRAIN: new submissions
+  get HTTP 503 immediately, the in-flight batch finishes and its
+  verdicts persist to the store (fleet mode: already-fed units get up
+  to ``drain_timeout`` for their workers to commit, then the feed is
+  closed), every still-queued entry resolves with an error so no
+  long-poller hangs, and the process exits;
+- **restart** — completed verdicts are durable files keyed on
+  ``(bytecode_hash, config_hash)``, so resubmitting after a kill
+  serves finished work from the dedupe store and re-analyzes only what
+  never committed: exactly-once results without any WAL. This is the
+  serve-layer face of the PR 4/5 kill+resume guarantees (the soak's
+  ``serve`` leg kills a daemon mid-batch and asserts it).
+
+A second signal while draining aborts the drain (fleet pending included)
+— the operator's escalation path when a batch is wedged.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from .http import ServeHTTPServer
+from .queue import AdmissionQueue
+from .scheduler import Scheduler
+from .store import ResultsStore
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ServeOptions:
+    """Daemon-level analysis configuration — the baseline every
+    submission's effective config derives from. ``OVERRIDABLE`` names
+    the per-request knobs; everything else is fixed at daemon start so
+    one tenant cannot stampede the compile cache with exotic shapes."""
+
+    batch_size: int = 8
+    lanes_per_contract: int = 32
+    max_steps: int = 256
+    transaction_count: int = 1
+    modules: Optional[List[str]] = None
+    limits_profile: str = "default"
+    solver_iters: int = 400
+    solver_timeout: Optional[float] = None
+    solver_workers: int = 1
+    batch_timeout: Optional[float] = None
+    max_batch_retries: int = 1
+    oom_ladder: Optional[Sequence[str]] = None
+    fault_inject: Optional[str] = None
+    concrete_storage: bool = False
+    #: per-request overrides accepted in the submit body's ``options``
+    OVERRIDABLE = ("max_steps", "transaction_count", "modules")
+
+    def effective(self, overrides: Dict) -> Dict:
+        """The config dict that keys dedupe (``config_hash``) and
+        shape-class bucketing. Unknown / non-overridable option keys
+        raise — silently ignoring them would dedupe two analyses the
+        client believes are different."""
+        bad = [k for k in overrides if k not in self.OVERRIDABLE]
+        if bad:
+            raise ValueError(
+                f"options {sorted(bad)} are not overridable per "
+                f"request (allowed: {list(self.OVERRIDABLE)})")
+        cfg = {
+            "batch_size": self.batch_size,
+            "lanes_per_contract": self.lanes_per_contract,
+            "max_steps": int(overrides.get("max_steps",
+                                           self.max_steps)),
+            "transaction_count": int(
+                overrides.get("transaction_count",
+                              self.transaction_count)),
+            "modules": (list(overrides["modules"])
+                        if overrides.get("modules") is not None
+                        else (list(self.modules)
+                              if self.modules else None)),
+            "limits_profile": self.limits_profile,
+            "solver_iters": self.solver_iters,
+            "solver_timeout": self.solver_timeout,
+            "solver_workers": self.solver_workers,
+            "batch_timeout": self.batch_timeout,
+            "max_batch_retries": self.max_batch_retries,
+            "oom_ladder": (tuple(self.oom_ladder)
+                           if self.oom_ladder is not None else None),
+            "fault_inject": self.fault_inject,
+            "concrete_storage": self.concrete_storage,
+        }
+        return cfg
+
+
+class AnalysisDaemon:
+    def __init__(self, options: Optional[ServeOptions] = None,
+                 data_dir: str = "serve_data",
+                 host: str = "127.0.0.1", port: int = 8780,
+                 dedupe: bool = True, max_queue: int = 4096,
+                 drain_timeout: float = 30.0,
+                 fleet_dir: Optional[str] = None,
+                 campaign_factory=None):
+        self.options = options or ServeOptions()
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.store = ResultsStore(os.path.join(data_dir, "store"))
+        self.queue = AdmissionQueue(
+            store=self.store, dedupe=dedupe, max_depth=max_queue,
+            config_fn=self.options.effective)
+        self.scheduler = Scheduler(
+            self.queue, store=self.store,
+            batch_size=self.options.batch_size,
+            fleet_dir=fleet_dir, campaign_factory=campaign_factory)
+        self.host = host
+        self._port = port
+        self.drain_timeout = float(drain_timeout)
+        self.httpd: Optional[ServeHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self.state = "starting"
+        self.t_start = time.monotonic()
+        self._done = threading.Event()
+        self._shutdown_lock = threading.Lock()
+        self._signals = 0
+
+    # --- surface the HTTP layer routes through --------------------------
+    def submit(self, contracts: Sequence[Tuple[str, bytes]], **kw):
+        if self.state != "serving":
+            from .queue import QueueClosed
+
+            raise QueueClosed(f"daemon is {self.state}")
+        return self.queue.submit(contracts, **kw)
+
+    def health(self) -> Dict:
+        return {
+            "ok": True,
+            "state": self.state,
+            "queue_depth": self.queue.depth(),
+            "batches_run": self.scheduler.batches_run,
+            "fleet_units_pending": self.scheduler.pending_fleet_units(),
+            "store_verdicts": self.store.count(),
+            "uptime_sec": round(time.monotonic() - self.t_start, 3),
+            "pid": os.getpid(),
+        }
+
+    @property
+    def port(self) -> int:
+        """The BOUND port (``--port 0`` asks the OS for a free one)."""
+        if self.httpd is not None:
+            return self.httpd.server_address[1]
+        return self._port
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        obs_metrics.REGISTRY.enabled = True  # /metrics is always on
+        self.scheduler.start()
+        self.httpd = ServeHTTPServer((self.host, self._port), self)
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="serve-http")
+        self._http_thread.start()
+        self.state = "serving"
+        obs_trace.event("serve_started", host=self.host, port=self.port,
+                        data_dir=self.data_dir)
+        log.info("serving on %s:%d (data dir %s)", self.host, self.port,
+                 self.data_dir)
+
+    def shutdown(self, reason: str = "shutdown") -> None:
+        """Graceful drain; idempotent and safe from any thread (the
+        signal path runs it on a helper thread so the handler itself
+        stays async-signal-trivial)."""
+        with self._shutdown_lock:
+            if self.state in ("draining", "stopped"):
+                return
+            self.state = "draining"
+        obs_trace.event("serve_draining", reason=reason)
+        log.info("draining (%s): rejecting new submissions, finishing "
+                 "the in-flight batch", reason)
+        self.queue.close()
+        self.scheduler.request_stop()
+        if not self.scheduler.join(self.drain_timeout):
+            # the in-flight batch (or a fleet worker) is wedged past
+            # the budget: abandon it — its entries resolve as errors,
+            # its verdicts simply never land (re-analyzed on restart)
+            log.warning("drain timeout (%.1fs): abandoning the "
+                        "in-flight work", self.drain_timeout)
+            self.scheduler.abort()
+            self.scheduler.join(2.0)
+        failed = self.queue.fail_pending(
+            "daemon shut down before this entry was scheduled; "
+            "resubmit — completed contracts will be served from the "
+            "dedupe store")
+        if failed:
+            log.info("failed %d still-queued entries", failed)
+        if self.httpd is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        self.state = "stopped"
+        obs_trace.event("serve_stopped", reason=reason,
+                        queued_failed=failed)
+        self._done.set()
+
+    def handle_signal(self, signum, frame=None) -> None:
+        """SIGTERM/SIGINT: first one drains, a second one escalates to
+        abort (the wedged-batch escape hatch)."""
+        self._signals += 1
+        if self._signals >= 2:
+            self.scheduler.abort()
+        name = signal.Signals(signum).name
+        threading.Thread(target=self.shutdown, args=(name,),
+                         daemon=True,
+                         name="serve-shutdown").start()
+
+    def install_signal_handlers(self) -> None:
+        signal.signal(signal.SIGTERM, self.handle_signal)
+        signal.signal(signal.SIGINT, self.handle_signal)
+
+    def serve_forever(self) -> None:
+        """Start, then block until a signal (or another thread's
+        :meth:`shutdown`) completes the drain."""
+        self.start()
+        self._done.wait()
+
+    def wait_stopped(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+__all__ = ["AnalysisDaemon", "ServeOptions"]

@@ -1,0 +1,234 @@
+"""Thin stdlib HTTP surface for the analysis daemon (docs/serving.md).
+
+Endpoints (JSON in/out, no dependencies beyond ``http.server``):
+
+- ``POST /v1/submit`` — body ``{"code": "<hex>"}`` or ``{"contracts":
+  [{"name": "...", "code": "<hex>"}, ...]}`` plus optional ``tenant``,
+  ``priority`` (int, higher first), ``deadline_sec`` (float) and
+  ``options`` (per-request analysis overrides, see
+  ``ServeOptions.OVERRIDABLE``). Returns 202 with the submission id
+  (dedupe-served entries are already in ``results``), 429 when the
+  queue is full, 503 while draining, 400 on a malformed body.
+- ``GET /v1/result/<id>[?wait=SEC]`` — submission snapshot; ``wait``
+  long-polls until NEW results commit (or the timeout lapses).
+- ``GET /v1/result/<id>?stream=1`` — chunked transfer: one JSON line
+  per contract result, written in COMMIT ORDER as batches land; the
+  response ends when the submission completes. A slow or dead reader
+  costs one daemon thread, nothing else (ThreadingHTTPServer).
+- ``GET /healthz`` — liveness + ``serving``/``draining`` state (a
+  draining daemon answers, so orchestrators can distinguish "dying
+  gracefully" from "dead").
+- ``GET /metrics`` — the obs registry in Prometheus text exposition
+  format (the same payload ``--metrics FILE.prom`` snapshots).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from .queue import QueueClosed, QueueFull
+
+#: cap on submission body size: serve is an analysis API, not an
+#: artifact store; 64 MiB covers thousands of max-size contracts
+MAX_BODY = 64 << 20
+
+
+def parse_submit_body(doc: Dict) -> Tuple[list, Dict]:
+    """``(contracts, kwargs-for-queue.submit)`` from a request body;
+    raises ValueError with a client-facing message."""
+    if not isinstance(doc, dict):
+        raise ValueError("body must be a JSON object")
+    contracts = []
+    if "contracts" in doc:
+        if not isinstance(doc["contracts"], list) or not doc["contracts"]:
+            raise ValueError("'contracts' must be a non-empty list")
+        for k, c in enumerate(doc["contracts"]):
+            if not isinstance(c, dict) or "code" not in c:
+                raise ValueError("each contract needs a 'code' hex field")
+            contracts.append((str(c.get("name", f"contract_{k}")),
+                              _hex_bytes(c["code"])))
+    elif "code" in doc:
+        contracts.append((str(doc.get("name", "contract_0")),
+                          _hex_bytes(doc["code"])))
+    else:
+        raise ValueError("provide 'code' or 'contracts'")
+    opts = doc.get("options") or {}
+    if not isinstance(opts, dict):
+        raise ValueError("'options' must be an object")
+    kw = {
+        "tenant": str(doc.get("tenant", "default")),
+        "priority": int(doc.get("priority", 0)),
+        "options": opts,
+    }
+    if doc.get("deadline_sec") is not None:
+        kw["deadline_sec"] = float(doc["deadline_sec"])
+    return contracts, kw
+
+
+def _hex_bytes(text) -> bytes:
+    if not isinstance(text, str):
+        raise ValueError("bytecode must be a hex string")
+    t = text.strip().removeprefix("0x")
+    try:
+        return bytes.fromhex(t)
+    except ValueError:
+        raise ValueError("bytecode is not valid hex") from None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "mythril-tpu-serve"
+
+    # route access logs to logging.debug instead of stderr chatter
+    def log_message(self, fmt, *args):  # noqa: D102
+        import logging
+
+        logging.getLogger(__name__).debug("http: " + fmt, *args)
+
+    @property
+    def daemon(self):
+        return self.server.analysis_daemon
+
+    # --- helpers --------------------------------------------------------
+    def _json(self, code: int, doc: Dict,
+              extra_headers: Dict = ()) -> None:
+        body = (json.dumps(doc, indent=1) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in dict(extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+
+    # --- routes ---------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802 (stdlib casing)
+        url = urllib.parse.urlparse(self.path)
+        if url.path not in ("/v1/submit", "/v1/submit/"):
+            self._json(404, {"error": f"no such endpoint {url.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = 0
+        if length <= 0 or length > MAX_BODY:
+            self._json(400, {"error": "missing or oversized body"})
+            return
+        try:
+            doc = json.loads(self.rfile.read(length))
+            contracts, kw = parse_submit_body(doc)
+        except ValueError as e:
+            self._json(400, {"error": str(e)})
+            return
+        try:
+            sub = self.daemon.submit(contracts, **kw)
+        except ValueError as e:
+            # non-overridable / unknown option keys (ServeOptions
+            # .effective) — a client error, not a daemon fault
+            self._json(400, {"error": str(e)})
+            return
+        except QueueClosed:
+            self._json(503, {"error": "daemon is draining; resubmit "
+                                      "to a live instance"},
+                       {"Retry-After": "5"})
+            return
+        except QueueFull as e:
+            self._json(429, {"error": str(e)}, {"Retry-After": "1"})
+            return
+        snap = sub.snapshot()
+        snap["queue_depth"] = self.daemon.queue.depth()
+        self._json(202, snap)
+
+    def do_GET(self) -> None:  # noqa: N802
+        url = urllib.parse.urlparse(self.path)
+        q = urllib.parse.parse_qs(url.query)
+        if url.path == "/healthz":
+            self._json(200, self.daemon.health())
+            return
+        if url.path == "/metrics":
+            body = obs_metrics.REGISTRY.to_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            return
+        if url.path.startswith("/v1/result/"):
+            sid = url.path[len("/v1/result/"):].strip("/")
+            sub = self.daemon.queue.get(sid)
+            if sub is None:
+                self._json(404, {"error": f"unknown submission {sid!r}"})
+                return
+            if q.get("stream", ["0"])[0] in ("1", "true", "yes"):
+                self._stream(sub)
+                return
+            wait = float(q.get("wait", ["0"])[0] or 0)
+            if wait > 0:
+                sub.wait_done(timeout=min(wait, 300.0))
+            self._json(200, sub.snapshot())
+            return
+        self._json(404, {"error": f"no such endpoint {url.path}"})
+
+    def _stream(self, sub) -> None:
+        """Chunked per-contract result stream in commit order. Each
+        chunk is one JSON line; the final chunk is a ``done`` marker
+        carrying the totals."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        reg = obs_metrics.REGISTRY
+        sent = 0
+        with obs_trace.span("stream", id=sub.sid):
+            try:
+                while True:
+                    snap = sub.snapshot()
+                    results = snap["results"]
+                    while sent < len(results):
+                        self._chunk(json.dumps(
+                            results[sent]).encode() + b"\n")
+                        reg.counter(
+                            "serve_results_streamed_total",
+                            help="per-contract results written to "
+                                 "streaming responses").inc()
+                        sent += 1
+                    if snap["state"] == "done":
+                        break
+                    sub.wait_results(sent, timeout=5.0)
+                self._chunk(json.dumps(
+                    {"done": True, "id": sub.sid,
+                     "contracts": snap["contracts"],
+                     "completed": sent}).encode() + b"\n")
+                self._chunk(b"")  # terminal zero-length chunk
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # reader went away; the verdicts are still stored
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    """One daemon thread per connection; ``analysis_daemon`` is the
+    back-reference the handler routes through."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr: Tuple[str, int], analysis_daemon):
+        super().__init__(addr, _Handler)
+        self.analysis_daemon = analysis_daemon
+
+
+__all__ = ["MAX_BODY", "ServeHTTPServer", "parse_submit_body"]

@@ -1,0 +1,418 @@
+"""Admission queue: dedupe before lanes, priority + deadline ordering.
+
+The front half of the serve layer (docs/serving.md). Every submitted
+contract becomes one :class:`Entry`; admission runs, in order:
+
+1. **store dedupe** — a persisted verdict under the same
+   ``(bytecode_hash, config_hash)`` resolves the entry immediately
+   (``served_from="dedupe-store"``), no lane is touched;
+2. **in-flight dedupe** — the same key already queued or running
+   attaches this entry as a FOLLOWER of the primary: when the primary's
+   batch commits, every follower resolves from the same verdict
+   (``served_from="dedupe-inflight"``) — N concurrent submitters of one
+   proxy bytecode cost one analysis;
+3. **admission** — the entry joins the queue, ordered by
+   ``(-priority, deadline, arrival)``: higher tenant priority first,
+   earlier deadline breaks ties, FIFO within equals. A bounded queue
+   (``max_depth``) rejects the overflow with :class:`QueueFull` (HTTP
+   429) instead of buffering unboundedly.
+
+Entries whose deadline lapses while queued are EVICTED at scheduling
+time (``status="evicted"``) — a deadline is "answer by", not "try
+anyway"; the scheduler never spends lanes on an answer nobody is
+waiting for.
+
+Telemetry: an ``admit`` span per submission, a ``queue_wait`` span per
+entry (emitted when the scheduler pops it, measuring time spent
+queued), ``serve_requests_total`` / ``serve_contracts_total`` /
+``serve_dedupe_hits_total`` / ``serve_evicted_total`` counters and the
+``serve_queue_depth`` gauge.
+
+Thread-safety: one condition guards the queue, the in-flight index and
+every entry/submission state transition; HTTP threads submit and wait,
+the scheduler thread pops and resolves.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from .store import ResultsStore, bytecode_hash, config_hash
+
+
+class QueueFull(Exception):
+    """Admission would exceed ``max_depth`` — back off and retry."""
+
+
+class QueueClosed(Exception):
+    """The daemon is draining; no new submissions (HTTP 503)."""
+
+
+#: config keys that define the ENGINE SHAPE a contract compiles into —
+#: entries batch together only within one shape class, so one compiled
+#: executable serves the whole batch
+SHAPE_KEYS = ("batch_size", "lanes_per_contract", "max_steps",
+              "transaction_count")
+
+
+def shape_key_of(config: Dict) -> Tuple:
+    return tuple(config.get(k) for k in SHAPE_KEYS)
+
+
+class Entry:
+    """One contract of one submission, from admission to verdict."""
+
+    __slots__ = ("eid", "name", "code", "bch", "cfh", "config",
+                 "shape_key", "priority", "deadline", "seq", "state",
+                 "result", "submission", "followers", "t_submit")
+
+    def __init__(self, eid: str, name: str, code: bytes, config: Dict,
+                 priority: int, deadline: Optional[float], seq: int,
+                 submission: "Submission"):
+        self.eid = eid
+        self.name = name
+        self.code = code
+        self.bch = bytecode_hash(code)
+        self.cfh = config_hash(config)
+        self.config = config
+        self.shape_key = shape_key_of(config)
+        self.priority = priority
+        self.deadline = deadline        # absolute monotonic, or None
+        self.seq = seq
+        self.state = "queued"           # queued|running|done
+        self.result: Optional[Dict] = None
+        self.submission = submission
+        self.followers: List["Entry"] = []
+        self.t_submit = time.monotonic()
+
+    @property
+    def uname(self) -> str:
+        """Engine-side contract name: unique within any batch (issue
+        attribution maps back through it), never colliding with the
+        campaign's ``_pad_*`` stubs."""
+        return f"{self.name}@{self.eid}"
+
+    def sort_key(self) -> Tuple:
+        return (-self.priority,
+                self.deadline if self.deadline is not None
+                else float("inf"),
+                self.seq)
+
+
+class Submission:
+    """One ``POST /v1/submit`` — a list of entries plus the stream of
+    their results in COMMIT ORDER (dedupe-served entries first, then
+    batch commits as they land)."""
+
+    def __init__(self, sid: str, tenant: str, cond: threading.Condition):
+        self.sid = sid
+        self.tenant = tenant
+        self.t = time.time()
+        self.entries: List[Entry] = []
+        #: per-contract results, appended strictly in commit order —
+        #: the ``?stream=1`` wire order
+        self.results: List[Dict] = []
+        self._cond = cond
+
+    @property
+    def done(self) -> bool:
+        return len(self.results) >= len(self.entries)
+
+    def wait_results(self, seen: int, timeout: Optional[float]) -> bool:
+        """Block until ``results`` grew past ``seen`` (or the
+        submission finished, or the timeout lapsed). Returns done."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while len(self.results) <= seen and not self.done:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                self._cond.wait(remaining if remaining is not None
+                                else 1.0)
+            return self.done
+
+    def wait_done(self, timeout: Optional[float]) -> bool:
+        """Block until every entry resolved (long-poll). Returns
+        done."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while not self.done:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                self._cond.wait(remaining if remaining is not None
+                                else 1.0)
+            return self.done
+
+    def snapshot(self) -> Dict:
+        with self._cond:
+            results = list(self.results)
+            done = len(results) >= len(self.entries)
+            return {"id": self.sid, "tenant": self.tenant,
+                    "contracts": len(self.entries),
+                    "completed": len(results),
+                    "state": "done" if done else "pending",
+                    "results": results}
+
+
+class AdmissionQueue:
+    def __init__(self, store: Optional[ResultsStore] = None,
+                 dedupe: bool = True, max_depth: int = 4096,
+                 config_fn: Optional[Callable[[Dict], Dict]] = None):
+        self.store = store
+        self.dedupe = bool(dedupe) and store is not None
+        self.max_depth = max(1, int(max_depth))
+        #: merges per-request option overrides into the daemon's base
+        #: analysis config — the dict that config_hash covers
+        self.config_fn = config_fn or (lambda overrides: dict(overrides))
+        self.closed = False
+        self._cond = threading.Condition()
+        self._queue: List[Entry] = []
+        self._inflight: Dict[Tuple[str, str], Entry] = {}
+        self._subs: Dict[str, Submission] = {}
+        self._seq = itertools.count()
+        self._nsub = itertools.count()
+        self._reg = obs_metrics.REGISTRY
+
+    # --- admission ------------------------------------------------------
+    def _depth_gauge(self) -> None:
+        self._reg.gauge(
+            "serve_queue_depth",
+            help="entries admitted and not yet scheduled").set(
+            len(self._queue))
+
+    def submit(self, contracts: Sequence[Tuple[str, bytes]],
+               tenant: str = "default", priority: int = 0,
+               deadline_sec: Optional[float] = None,
+               options: Optional[Dict] = None) -> Submission:
+        """Admit one submission of ``(name, bytecode)`` pairs. Raises
+        :class:`QueueClosed` while draining, :class:`QueueFull` when
+        the whole submission cannot fit (all-or-nothing: a partially
+        admitted submission would stream a partial result set that
+        LOOKS complete)."""
+        config = self.config_fn(dict(options or {}))
+        with obs_trace.timer("admit", tenant=tenant,
+                             n=len(contracts)) as sp:
+            with self._cond:
+                if self.closed:
+                    raise QueueClosed("daemon is draining")
+                self._reg.counter(
+                    "serve_requests_total",
+                    help="submissions accepted for admission").inc()
+                self._reg.counter("serve_contracts_total").inc(
+                    len(contracts))
+                sid = f"s{next(self._nsub):06d}-{os.getpid():x}"
+                sub = Submission(sid, tenant, self._cond)
+                fresh: List[Entry] = []
+                deadline = (None if deadline_sec is None
+                            else time.monotonic() + float(deadline_sec))
+                for name, code in contracts:
+                    e = Entry(f"e{next(self._seq):07d}", str(name),
+                              bytes(code), config, int(priority),
+                              deadline, next(self._seq), sub)
+                    sub.entries.append(e)
+                    key = (e.bch, e.cfh)
+                    if self.dedupe:
+                        doc = self.store.get(e.bch, e.cfh)
+                        if doc is not None:
+                            self._reg.counter(
+                                "serve_dedupe_hits_total",
+                                help="submissions served from the "
+                                     "verdict store or in-flight "
+                                     "work, no lane touched").inc()
+                            self._resolve_locked(
+                                e, self._verdict_result(e, doc),
+                                served_from="dedupe-store")
+                            continue
+                        # in-flight attach covers clones WITHIN this
+                        # submission too (the index is updated as
+                        # entries are admitted below): a corpus of N
+                        # proxy copies costs one analysis, not N
+                        primary = self._inflight.get(key)
+                        if primary is not None:
+                            self._reg.counter(
+                                "serve_dedupe_hits_total",
+                                help="submissions served from the "
+                                     "verdict store or in-flight "
+                                     "work, no lane touched").inc()
+                            primary.followers.append(e)
+                            e.state = "running"
+                            continue
+                        self._inflight[key] = e
+                    fresh.append(e)
+                if len(self._queue) + len(fresh) > self.max_depth:
+                    # roll back: drop this submission's in-flight
+                    # registrations and followers (resolved store-hits
+                    # stand — they cost nothing, their verdicts are
+                    # real)
+                    for e in fresh:
+                        e.state = "done"
+                        if self._inflight.get((e.bch, e.cfh)) is e:
+                            del self._inflight[(e.bch, e.cfh)]
+                    for e in sub.entries:
+                        primary = self._inflight.get((e.bch, e.cfh))
+                        if primary is not None and e in primary.followers:
+                            primary.followers.remove(e)
+                    raise QueueFull(
+                        f"queue depth {len(self._queue)} + "
+                        f"{len(fresh)} exceeds {self.max_depth}")
+                for e in fresh:
+                    self._queue.append(e)
+                self._subs[sid] = sub
+                self._depth_gauge()
+                self._cond.notify_all()
+        sp.attrs["id"] = sub.sid
+        return sub
+
+    @staticmethod
+    def _verdict_result(e: Entry, doc: Dict) -> Dict:
+        """Entry result from a stored verdict: the issues are re-homed
+        onto THIS entry's display name (the verdict was computed under
+        some other submission's engine name)."""
+        issues = []
+        for i in doc.get("issues") or []:
+            i = dict(i)
+            i["contract"] = e.name
+            issues.append(i)
+        return {"status": str(doc.get("status", "ok")),
+                "issues": issues}
+
+    # --- scheduling side ------------------------------------------------
+    def _evict_expired_locked(self, now: float) -> None:
+        keep = []
+        for e in self._queue:
+            if e.deadline is not None and now >= e.deadline:
+                self._reg.counter(
+                    "serve_evicted_total",
+                    help="entries whose deadline lapsed while "
+                         "queued").inc()
+                if self._inflight.get((e.bch, e.cfh)) is e:
+                    del self._inflight[(e.bch, e.cfh)]
+                self._resolve_locked(
+                    e, {"status": "evicted",
+                        "error": "deadline exceeded before scheduling"},
+                    served_from=None)
+            else:
+                keep.append(e)
+        self._queue = keep
+
+    def pop_batch(self, max_items: int,
+                  timeout: Optional[float] = None) -> List[Entry]:
+        """The scheduler's drain: block up to ``timeout`` for work,
+        evict lapsed deadlines, then pop the best-priority entry plus
+        up to ``max_items - 1`` more entries of the SAME effective
+        config (one module list, one engine shape — one compiled
+        executable and one host-phase recipe serve the whole batch) in
+        priority order. Different configs of one shape class still
+        share compiled executables ACROSS batches via the scheduler's
+        warm-shape registry."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            while True:
+                self._evict_expired_locked(time.monotonic())
+                if self._queue:
+                    break
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    self._depth_gauge()
+                    return []
+                self._cond.wait(remaining if remaining is not None
+                                else 1.0)
+            ordered = sorted(self._queue, key=Entry.sort_key)
+            cfh = ordered[0].cfh
+            batch = [e for e in ordered
+                     if e.cfh == cfh][:max(1, int(max_items))]
+            taken = set(id(e) for e in batch)
+            self._queue = [e for e in self._queue
+                           if id(e) not in taken]
+            now = time.monotonic()
+            for e in batch:
+                e.state = "running"
+                obs_trace.complete("queue_wait", now - e.t_submit,
+                                   eid=e.eid, tenant=e.submission.tenant,
+                                   priority=e.priority)
+                self._reg.histogram(
+                    "serve_queue_wait_seconds",
+                    help="admission-to-schedule latency").observe(
+                    now - e.t_submit)
+            self._depth_gauge()
+            return batch
+
+    # --- resolution -----------------------------------------------------
+    def _resolve_locked(self, e: Entry, result: Dict,
+                        served_from: Optional[str]) -> None:
+        if e.state == "done":
+            return
+        e.state = "done"
+        res = dict(result)
+        res.setdefault("status", "ok")
+        res["name"] = e.name
+        res["bytecode_hash"] = e.bch
+        res["config_hash"] = e.cfh
+        if served_from:
+            res["served_from"] = served_from
+        e.result = res
+        e.submission.results.append(res)
+        for f in e.followers:
+            self._resolve_locked(f, self._verdict_result(f, res),
+                                 served_from="dedupe-inflight")
+        e.followers = []
+
+    def resolve(self, e: Entry, result: Dict,
+                served_from: Optional[str] = None) -> None:
+        """Scheduler-side: commit one entry's verdict (and its
+        followers') and wake every waiter. ``served_from`` marks
+        DEDUPE provenance only; a fresh analysis carries no marker."""
+        with self._cond:
+            self._inflight.pop((e.bch, e.cfh), None)
+            self._resolve_locked(e, result, served_from)
+            self._cond.notify_all()
+
+    # --- lifecycle ------------------------------------------------------
+    def get(self, sid: str) -> Optional[Submission]:
+        with self._cond:
+            return self._subs.get(sid)
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def close(self) -> None:
+        """Stop admitting (drain begins). Queued entries stay queued —
+        the scheduler decides whether to run or fail them."""
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+    def fail_pending(self, reason: str) -> int:
+        """Resolve every still-queued entry with an error status (the
+        drain's last act: nothing may wait forever on a daemon that is
+        exiting). Returns how many were failed."""
+        with self._cond:
+            n = 0
+            for e in list(self._queue):
+                self._inflight.pop((e.bch, e.cfh), None)
+                self._resolve_locked(
+                    e, {"status": "error", "error": reason},
+                    served_from=None)
+                n += 1
+            self._queue = []
+            self._depth_gauge()
+            self._cond.notify_all()
+            return n
+
+
+__all__ = ["AdmissionQueue", "Entry", "QueueClosed", "QueueFull",
+           "SHAPE_KEYS", "Submission", "shape_key_of"]

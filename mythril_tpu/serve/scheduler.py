@@ -1,0 +1,295 @@
+"""Scheduler: drains the admission queue into resident campaigns.
+
+The loop that makes the daemon WARM (docs/serving.md): it pops
+same-config batches from the queue and runs them through one
+long-lived :class:`CorpusCampaign` per effective config — the full PR
+1/2 resilience machinery (watchdog / OOM ladder / retry / bisect)
+applies per batch, and because all campaigns share ONE warm-shape
+registry (keyed by the engine shape class: batch width x lanes x step
+budget x tx count), the second batch of any shape replays ``sym_run``'s
+process-wide XLA cache instead of recompiling
+(``serve_warm_compile_hits_total``). Verdicts are persisted to the
+results store as each batch commits, so completed work survives a
+daemon kill and is served from dedupe after restart — exactly once.
+
+With a ``fleet_dir`` the scheduler FRONTS a fleet instead of running
+locally (docs/fleet.md): admitted batches are appended to a FEED ledger
+as self-contained work units (bytecode rides the unit descriptor),
+remote ``--fleet-follow`` workers claim/heartbeat/commit them, and this
+loop polls committed unit results back into the same entry-resolution
+path. Dedupe and queue semantics are identical — the fleet only
+replaces WHERE lanes run.
+
+Single scheduler thread; entry resolution goes through the queue's one
+condition, so HTTP waiters wake exactly when their results commit.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from .queue import AdmissionQueue, Entry
+from .store import ResultsStore
+
+log = logging.getLogger(__name__)
+
+
+def default_campaign_factory(config: Dict):
+    """Build the resident engine for one effective config. Loads the
+    engine lazily — the daemon stays backend-free until the first
+    non-dedupe submission actually needs lanes."""
+    from ..config import DEFAULT_LIMITS, TEST_LIMITS
+    from ..mythril.campaign import CorpusCampaign
+    from ..resilience import FaultInjector
+
+    limits = (TEST_LIMITS if config.get("limits_profile") == "test"
+              else DEFAULT_LIMITS)
+    spec = None
+    if config.get("concrete_storage"):
+        from ..symbolic import SymSpec
+
+        spec = SymSpec(storage=False)
+    return CorpusCampaign(
+        [],
+        batch_size=int(config.get("batch_size", 8)),
+        lanes_per_contract=int(config.get("lanes_per_contract", 32)),
+        limits=limits,
+        spec=spec,
+        max_steps=int(config.get("max_steps", 256)),
+        transaction_count=int(config.get("transaction_count", 1)),
+        modules=config.get("modules"),
+        solver_timeout=config.get("solver_timeout"),
+        solver_iters=int(config.get("solver_iters", 400)),
+        batch_timeout=config.get("batch_timeout"),
+        max_batch_retries=int(config.get("max_batch_retries", 1)),
+        fault_injector=FaultInjector.from_string(
+            config.get("fault_inject")),
+        oom_ladder=config.get("oom_ladder"),
+        solver_workers=int(config.get("solver_workers", 1)),
+    )
+
+
+class Scheduler:
+    def __init__(self, queue: AdmissionQueue,
+                 store: Optional[ResultsStore] = None,
+                 batch_size: int = 8,
+                 poll: float = 0.25,
+                 fleet_dir: Optional[str] = None,
+                 campaign_factory: Optional[Callable] = None):
+        self.queue = queue
+        self.store = store
+        self.batch_size = max(1, int(batch_size))
+        self.poll = max(0.02, float(poll))
+        self.fleet_dir = fleet_dir
+        self.campaign_factory = campaign_factory or default_campaign_factory
+        #: one resident campaign per effective config (cfh); all share
+        #: the warm-shape registry below, so config variants of one
+        #: ENGINE shape class (same width/lanes/steps/tx, e.g. a
+        #: different module list) still count as warm
+        self._campaigns: Dict[str, object] = {}
+        self._warm_shapes: Dict[tuple, set] = {}
+        self._ledger = None
+        #: fleet mode: fed-but-uncommitted units -> their entries
+        self._pending: Dict[str, List[Entry]] = {}
+        self._stop = threading.Event()     # drain: finish in-flight
+        self._abort = threading.Event()    # give up on fleet pending
+        self._thread: Optional[threading.Thread] = None
+        self.batches_run = 0
+        self._reg = obs_metrics.REGISTRY
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        if self.fleet_dir is not None:
+            from ..fleet import WorkLedger
+
+            self._ledger = WorkLedger(self.fleet_dir)
+            self._ledger.ensure_feed()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-scheduler")
+        self._thread.start()
+
+    def request_stop(self) -> None:
+        """Begin draining: the in-flight batch (and, fleet mode,
+        already-fed units) completes; nothing new is popped. Pair with
+        ``queue.close()`` so nothing new is admitted either."""
+        self._stop.set()
+
+    def abort(self) -> None:
+        """Hard stop: also abandon fed-but-uncommitted fleet units
+        (their entries resolve as errors so no waiter hangs)."""
+        self._abort.set()
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    # --- the loop -------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            if self._ledger is not None:
+                self._poll_fleet()
+            if self._stop.is_set():
+                if self._ledger is None or not self._pending \
+                        or self._abort.is_set():
+                    break
+                # draining a fleet: the fed units are on remote
+                # workers; keep polling for their commits
+                time.sleep(min(self.poll, 0.1))
+                continue
+            entries = self.queue.pop_batch(self.batch_size,
+                                           timeout=self.poll)
+            if not entries:
+                continue
+            try:
+                if self._ledger is not None:
+                    self._feed_batch(entries)
+                else:
+                    self._run_batch(entries)
+            except Exception as e:  # noqa: BLE001 — no waiter may hang
+                log.exception("serve batch failed")
+                self._reg.counter(
+                    "serve_batch_errors_total",
+                    help="scheduler batches that raised").inc()
+                for en in entries:
+                    self.queue.resolve(
+                        en, {"status": "error",
+                             "error": f"{type(e).__name__}: "
+                                      f"{str(e)[:200]}"})
+        if self._abort.is_set() and self._pending:
+            for uid, entries in list(self._pending.items()):
+                for en in entries:
+                    self.queue.resolve(
+                        en, {"status": "error",
+                             "error": "daemon exited before fleet "
+                                      f"unit {uid} committed"})
+            self._pending.clear()
+        if self._ledger is not None:
+            # tell --fleet-follow workers the feed is complete so they
+            # drain and exit instead of polling a dead daemon's ledger
+            try:
+                self._ledger.feed_close()
+            except OSError:
+                pass
+
+    # --- local (resident-campaign) execution ----------------------------
+    def _campaign_for(self, e: Entry):
+        camp = self._campaigns.get(e.cfh)
+        if camp is None:
+            camp = self.campaign_factory(e.config)
+            # one warm-shape registry across every resident campaign:
+            # sym_run's XLA cache is process-wide, so warmth is a
+            # process property, not a per-config one
+            if hasattr(camp, "_warm_shapes"):
+                camp._warm_shapes = self._warm_shapes
+            self._campaigns[e.cfh] = camp
+        return camp
+
+    def _run_batch(self, entries: List[Entry]) -> None:
+        camp = self._campaign_for(entries[0])
+        warm = False
+        if hasattr(camp, "shape_is_warm"):
+            warm = bool(camp.shape_is_warm())
+        items = [(e.uname, e.code) for e in entries]
+        with obs_trace.span("schedule", n=len(entries),
+                            cfh=entries[0].cfh, warm=warm):
+            out = camp.run_external_batch(items)
+        self.batches_run += 1
+        self._reg.counter(
+            "serve_batches_total",
+            help="batches the scheduler ran through resident "
+                 "campaigns").inc()
+        if warm:
+            self._reg.counter(
+                "serve_warm_compile_hits_total",
+                help="batches that reused an already-compiled engine "
+                     "shape class (no XLA recompile)").inc()
+        self._bind_results(entries, out.get("issues") or [],
+                           out.get("quarantined") or [],
+                           batch=out.get("batch"),
+                           batch_status=str(out.get("status", "ok")))
+
+    def _bind_results(self, entries: List[Entry], issues: List[Dict],
+                      quarantined: List[Dict],
+                      batch=None, batch_status: str = "ok") -> None:
+        """Map a batch's engine output back onto its entries (issues
+        and quarantine records name the per-entry ``uname``), persist
+        fresh verdicts, resolve every entry + its dedupe followers."""
+        by_uname: Dict[str, List[Dict]] = {}
+        for i in issues:
+            by_uname.setdefault(str(i.get("contract")), []).append(i)
+        quar = {str(q.get("name")): q for q in quarantined}
+        for e in entries:
+            if e.uname in quar:
+                # a poison contract's verdict is an error, not a
+                # finding — do NOT cache it (the quarantine reason may
+                # be environmental: a wedged device, an OOM'd rung)
+                self.queue.resolve(
+                    e, {"status": "quarantined",
+                        "error": str(quar[e.uname].get("reason",
+                                                       ""))[:300],
+                        "issues": [], "batch": batch})
+                continue
+            my = []
+            for i in by_uname.get(e.uname, []):
+                i = dict(i)
+                i["contract"] = e.name
+                my.append(i)
+            verdict = {"status": "ok", "issues": my,
+                       "batch_status": batch_status}
+            if self.store is not None and self.queue.dedupe:
+                self.store.put(e.bch, e.cfh, verdict)
+            res = dict(verdict)
+            res["batch"] = batch
+            self.queue.resolve(e, res)
+
+    # --- fleet-fed execution (docs/fleet.md) ----------------------------
+    def _feed_batch(self, entries: List[Entry]) -> None:
+        uid = self._ledger.feed_unit(
+            [(e.uname, e.code) for e in entries],
+            config=entries[0].config)
+        self._pending[uid] = entries
+        self._reg.counter(
+            "serve_fleet_units_fed_total",
+            help="admitted batches appended to the feed ledger").inc()
+        self._reg.gauge(
+            "serve_fleet_units_pending",
+            help="fed units awaiting a worker commit").set(
+            len(self._pending))
+
+    def _poll_fleet(self) -> None:
+        for uid, entries in list(self._pending.items()):
+            rec = self._ledger.result_record(uid)
+            if rec is not None:
+                self._bind_results(
+                    entries, rec.get("issues") or [],
+                    rec.get("quarantined") or [],
+                    batch=uid,
+                    batch_status=";".join(rec.get("batch_status")
+                                          or []) or "ok")
+                del self._pending[uid]
+                self.batches_run += 1
+                self._reg.counter("serve_batches_total").inc()
+                continue
+            if self._ledger.unit_lost(uid):
+                for e in entries:
+                    self.queue.resolve(
+                        e, {"status": "error",
+                            "error": f"fleet unit {uid} lost (re-lease "
+                                     "cap exhausted)"})
+                del self._pending[uid]
+        self._reg.gauge("serve_fleet_units_pending").set(
+            len(self._pending))
+
+    def pending_fleet_units(self) -> int:
+        return len(self._pending)
+
+
+__all__ = ["Scheduler", "default_campaign_factory"]

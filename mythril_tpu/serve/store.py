@@ -1,0 +1,129 @@
+"""Persistent per-contract verdict store, keyed on
+``(bytecode_hash, config_hash)``.
+
+The dedupe backbone of the serve layer (docs/serving.md) and the first
+slice of ROADMAP's cross-campaign constraint-verdict store: mainnet
+bytecode is dominated by proxy/clone copies, so most submissions should
+resolve here — a verdict lookup instead of lanes + solver work. The key
+pairs WHAT was analyzed (sha256 of the runtime bytecode) with HOW
+(sha256 of the effective analysis config: step budget, lanes, tx count,
+module list, solver knobs) — the same bytecode under a deeper budget is
+a different verdict, never served stale.
+
+Every verdict is one JSON file written with the repo-wide
+``utils/checkpoint.durable_write`` contract (tmp + fsync + atomic
+rename), so a SIGKILL mid-write never leaves a half verdict: the
+restarted daemon either has the verdict or re-analyzes — exactly-once
+either way. Corrupt files are treated as misses (and counted), not
+errors.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from ..obs import metrics as obs_metrics
+from ..utils.checkpoint import durable_write
+
+#: verdict-file schema (readers reject newer-than-known)
+STORE_SCHEMA = 1
+
+
+def bytecode_hash(code: bytes) -> str:
+    """Content identity of one runtime bytecode (sha256, 32 hex chars —
+    collision-safe at corpus scale, short enough for filenames)."""
+    return hashlib.sha256(bytes(code)).hexdigest()[:32]
+
+
+#: config keys that are OPERATIONAL, not semantic — they shape how a
+#: batch is supervised (watchdogs, retries, degradation, test fault
+#: injection, host-phase thread count) or packed (batch width is
+#: padding: the campaign's bisect/degrade machinery already treats
+#: per-contract verdicts as batch-composition-independent), never which
+#: issues exist in the bytecode. They are excluded from the verdict
+#: key: a daemon restarted with a different drain budget or batch width
+#: (or with a soak fault spec removed) must still recognize its own
+#: verdicts. ``lanes_per_contract`` stays SEMANTIC — fork capacity
+#: changes which paths survive.
+OPERATIONAL_KEYS = frozenset((
+    "fault_inject", "batch_timeout", "max_batch_retries", "oom_ladder",
+    "solver_workers", "batch_size"))
+
+
+def config_hash(config: Dict) -> str:
+    """Identity of the effective analysis configuration — the
+    SEMANTIC knobs only (step budget, lanes, tx count, modules, limits
+    profile, solver budget, storage model). Canonical JSON (sorted
+    keys) so dict ordering can't split the cache."""
+    sem = {k: v for k, v in config.items()
+           if k not in OPERATIONAL_KEYS}
+    blob = json.dumps(sem, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+class ResultsStore:
+    """One directory of verdict files: ``<dir>/<bch>.<cfh>.json``.
+
+    Single-writer (the scheduler thread), many readers (HTTP threads,
+    the queue's admission check); file-level atomicity via
+    ``durable_write`` is the whole concurrency story — no lock, no
+    index file to corrupt."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _file(self, bch: str, cfh: str) -> str:
+        return os.path.join(self.path, f"{bch}.{cfh}.json")
+
+    def get(self, bch: str, cfh: str) -> Optional[Dict]:
+        """The stored verdict, or None on miss. A corrupt or
+        newer-schema file is a MISS (re-analysis overwrites it) with a
+        counter tick, never an exception on the admission path."""
+        try:
+            with open(self._file(bch, cfh)) as fh:
+                doc = json.load(fh)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError):
+            obs_metrics.REGISTRY.counter(
+                "serve_store_corrupt_total",
+                help="unreadable verdict files treated as misses").inc()
+            return None
+        if (not isinstance(doc, dict)
+                or int(doc.get("schema", 0)) > STORE_SCHEMA
+                or doc.get("bytecode_hash") != bch):
+            obs_metrics.REGISTRY.counter(
+                "serve_store_corrupt_total",
+                help="unreadable verdict files treated as misses").inc()
+            return None
+        return doc
+
+    def put(self, bch: str, cfh: str, verdict: Dict) -> None:
+        """Durably persist one verdict (issues + status for one
+        contract under one config)."""
+        doc = {"schema": STORE_SCHEMA, "bytecode_hash": bch,
+               "config_hash": cfh, "t": round(time.time(), 3)}
+        doc.update(verdict)
+        durable_write(self._file(bch, cfh),
+                      json.dumps(doc, sort_keys=True).encode(),
+                      rotate=False)
+        obs_metrics.REGISTRY.counter(
+            "serve_store_writes_total",
+            help="verdicts persisted to the results store").inc()
+
+    def count(self) -> int:
+        """Number of stored verdicts (healthz diagnostics; O(dir))."""
+        try:
+            return sum(1 for f in os.listdir(self.path)
+                       if f.endswith(".json"))
+        except OSError:
+            return 0
+
+
+__all__ = ["STORE_SCHEMA", "ResultsStore", "bytecode_hash",
+           "config_hash"]

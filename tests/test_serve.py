@@ -1,0 +1,514 @@
+"""Always-on analysis service (docs/serving.md): admission queue,
+bytecode-hash dedupe, warm-compile reuse, streaming results, graceful
+shutdown.
+
+Most tests drive the REAL HTTP surface against an in-process daemon
+with a stub campaign (fast, deterministic, gate-controlled); the
+end-to-end test runs the real engine and asserts the acceptance
+criteria: identical issues across duplicate submissions, the second
+served from the dedupe store without touching a lane
+(``serve_dedupe_hits_total``), and a same-shape distinct contract
+skipping recompilation (``serve_warm_compile_hits_total`` up,
+``engine_compiles_total`` flat).
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.obs import metrics as obs_metrics
+from mythril_tpu.serve import (AdmissionQueue, AnalysisDaemon,
+                               QueueClosed, QueueFull, ResultsStore,
+                               ServeOptions)
+from mythril_tpu.serve.store import bytecode_hash, config_hash
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+import serve_client  # noqa: E402
+
+KILLABLE = assemble(0, "SELFDESTRUCT")
+SAFE = assemble(1, 0, "SSTORE", "STOP")
+#: stub protocol: \x01-prefixed code -> one issue, \x02 -> quarantined
+ISSUE_CODE = b"\x01" + bytes([7])
+CLEAN_CODE = b"\x00" + bytes([7])
+POISON_CODE = b"\x02" + bytes([7])
+
+
+def counter(name):
+    return obs_metrics.REGISTRY.counter(name).value
+
+
+@pytest.fixture(autouse=True)
+def _restore_registry_enabled():
+    # the daemon force-enables the process-global registry for
+    # /metrics; later suites must see the state they started with
+    was = obs_metrics.REGISTRY.enabled
+    yield
+    obs_metrics.REGISTRY.enabled = was
+
+
+class StubCampaign:
+    """Resident-campaign stand-in: instant verdicts from code-byte
+    markers, an optional gate that holds a batch in flight, and a
+    record of every batch's names in execution order."""
+
+    def __init__(self, gate=None):
+        self.gate = gate
+        self.calls = 0
+        self.batches = []
+
+    def shape_is_warm(self):
+        return self.calls > 0
+
+    def run_external_batch(self, items, bi=None):
+        if self.gate is not None:
+            assert self.gate.wait(30.0), "test gate never released"
+        bi = self.calls
+        self.calls += 1
+        self.batches.append([n for n, _ in items])
+        issues = [{"contract": n, "swc-id": "106", "title": "stub"}
+                  for n, c in items if c.startswith(b"\x01")]
+        quarantined = [{"name": n, "reason": "stub poison", "batch": bi}
+                       for n, c in items if c.startswith(b"\x02")]
+        return {"issues": issues, "paths": len(items), "dropped": 0,
+                "iprof": {}, "quarantined": quarantined, "retries": 0,
+                "status": "ok", "batch": bi, "wall_sec": 0.0}
+
+
+@pytest.fixture
+def daemon_factory(tmp_path):
+    daemons = []
+
+    def make(stub=None, data_dir=None, **kw):
+        kw.setdefault("options", ServeOptions(batch_size=4))
+        kw.setdefault("drain_timeout", 10.0)
+        factory = (lambda cfg: stub) if stub is not None else None
+        dm = AnalysisDaemon(
+            data_dir=str(data_dir or tmp_path / "serve_data"),
+            port=0, campaign_factory=factory, **kw)
+        dm.start()
+        daemons.append(dm)
+        return dm, f"http://127.0.0.1:{dm.port}"
+
+    yield make
+    for dm in daemons:
+        dm.scheduler.abort()
+        dm.shutdown("test teardown")
+
+
+# --- store / hashing units ---------------------------------------------
+
+def test_store_roundtrip_and_corruption(tmp_path):
+    st = ResultsStore(str(tmp_path / "store"))
+    bch = bytecode_hash(ISSUE_CODE)
+    cfh = config_hash({"max_steps": 64})
+    assert st.get(bch, cfh) is None
+    st.put(bch, cfh, {"status": "ok", "issues": [{"contract": "a"}]})
+    doc = st.get(bch, cfh)
+    assert doc["issues"] == [{"contract": "a"}]
+    assert st.count() == 1
+    # torn write -> miss, not an exception
+    p = os.path.join(str(tmp_path / "store"), f"{bch}.{cfh}.json")
+    with open(p, "w") as fh:
+        fh.write('{"half')
+    assert st.get(bch, cfh) is None
+
+
+def test_config_hash_ignores_operational_knobs():
+    base = {"max_steps": 64, "modules": ["AccidentallyKillable"]}
+    assert config_hash(base) == config_hash(
+        dict(base, fault_inject="hang:batch=1", batch_timeout=5.0,
+             max_batch_retries=3, oom_ladder=("cpu",),
+             solver_workers=4))
+    assert config_hash(base) != config_hash(dict(base, max_steps=128))
+
+
+def test_serve_options_rejects_unknown_override():
+    with pytest.raises(ValueError, match="not overridable"):
+        ServeOptions().effective({"lanes_per_contract": 4})
+    cfg = ServeOptions(max_steps=256).effective({"max_steps": 64})
+    assert cfg["max_steps"] == 64
+
+
+# --- queue units --------------------------------------------------------
+
+def test_queue_priority_and_deadline_ordering():
+    q = AdmissionQueue(store=None, dedupe=False, max_depth=16)
+    codes = {n: n.encode() for n in ("low", "hi", "mid_late",
+                                     "mid_soon")}
+    q.submit([("low", codes["low"])], priority=0)
+    q.submit([("mid_late", codes["mid_late"])], priority=5,
+             deadline_sec=60.0)
+    q.submit([("mid_soon", codes["mid_soon"])], priority=5,
+             deadline_sec=5.0)
+    q.submit([("hi", codes["hi"])], priority=9)
+    order = []
+    while q.depth():
+        batch = q.pop_batch(1, timeout=0.1)
+        order.extend(e.name for e in batch)
+        for e in batch:
+            q.resolve(e, {"status": "ok", "issues": []})
+    # higher priority first; earlier deadline breaks the tie; FIFO last
+    assert order == ["hi", "mid_soon", "mid_late", "low"]
+
+
+def test_queue_full_and_closed():
+    q = AdmissionQueue(store=None, dedupe=False, max_depth=2)
+    q.submit([("a", b"\x00a"), ("b", b"\x00b")])
+    with pytest.raises(QueueFull):
+        q.submit([("c", b"\x00c")])
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.submit([("d", b"\x00d")])
+
+
+def test_queue_inflight_dedupe_within_submission(tmp_path):
+    st = ResultsStore(str(tmp_path / "store"))
+    q = AdmissionQueue(store=st, dedupe=True, max_depth=16)
+    hits0 = counter("serve_dedupe_hits_total")
+    sub = q.submit([("orig", ISSUE_CODE), ("clone1", ISSUE_CODE),
+                    ("clone2", ISSUE_CODE)])
+    # one primary queued, two followers attached — nothing reaches a
+    # second lane slot
+    assert q.depth() == 1
+    assert counter("serve_dedupe_hits_total") - hits0 == 2
+    (e,) = q.pop_batch(4, timeout=0.1)
+    q.resolve(e, {"status": "ok",
+                  "issues": [{"contract": e.name, "swc-id": "106"}]})
+    assert sub.done
+    names = sorted(r["name"] for r in sub.results)
+    assert names == ["clone1", "clone2", "orig"]
+    # every result carries the issue, re-homed onto its own name
+    for r in sub.results:
+        assert [i["contract"] for i in r["issues"]] == [r["name"]]
+    assert sorted(r.get("served_from", "analysis")
+                  for r in sub.results) == [
+        "analysis", "dedupe-inflight", "dedupe-inflight"]
+
+
+# --- HTTP layer (stub campaign) -----------------------------------------
+
+def _submit(url, contracts, **kw):
+    return serve_client.submit(url, contracts, **kw)
+
+
+def test_http_submit_result_and_dedupe_store(daemon_factory):
+    stub = StubCampaign()
+    dm, url = daemon_factory(stub=stub)
+    hits0 = counter("serve_dedupe_hits_total")
+    snap = _submit(url, [("k", ISSUE_CODE), ("s", CLEAN_CODE)])
+    res = serve_client.get_result(url, snap["id"], wait=20.0)
+    assert res["state"] == "done"
+    by = {r["name"]: r for r in res["results"]}
+    assert len(by["k"]["issues"]) == 1 and by["s"]["issues"] == []
+    assert stub.calls == 1
+    # resubmit: both verdicts in the store now — no batch runs
+    snap2 = _submit(url, [("k2", ISSUE_CODE), ("s2", CLEAN_CODE)])
+    assert snap2["state"] == "done"   # resolved at admission
+    assert all(r["served_from"] == "dedupe-store"
+               for r in snap2["results"])
+    assert [i["contract"] for r in snap2["results"]
+            for i in r["issues"]] == ["k2"]
+    assert stub.calls == 1
+    assert counter("serve_dedupe_hits_total") - hits0 == 2
+
+
+def test_http_streaming_matches_commit_order(daemon_factory):
+    # batch_size=1 -> one commit per contract, FIFO within a priority:
+    # the chunked stream must yield exactly that order
+    stub = StubCampaign()
+    dm, url = daemon_factory(stub=stub,
+                             options=ServeOptions(batch_size=1))
+    names = [f"c{i}" for i in range(5)]
+    contracts = [(n, b"\x01" + n.encode()) for n in names]
+    snap = _submit(url, contracts)
+    got = []
+    for rec in serve_client.stream_results(url, snap["id"],
+                                           timeout=30.0):
+        if rec.get("done"):
+            assert rec["completed"] == len(names)
+            break
+        got.append(rec["name"])
+    # the engine saw unique per-entry names; strip the entry suffix
+    assert got == names == [b[0].split("@")[0] for b in stub.batches]
+
+
+def test_http_concurrent_submitters_inflight_dedupe(daemon_factory):
+    gate = threading.Event()
+    stub = StubCampaign(gate=gate)
+    dm, url = daemon_factory(stub=stub)
+    hits0 = counter("serve_dedupe_hits_total")
+    sids, errs = [], []
+
+    def one(k):
+        try:
+            sids.append(_submit(
+                url, [(f"t{k}", ISSUE_CODE)], tenant=f"t{k}")["id"])
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=one, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert not errs and len(sids) == 4
+    gate.set()
+    outs = [serve_client.get_result(url, sid, wait=20.0)
+            for sid in sids]
+    assert all(o["state"] == "done" for o in outs)
+    assert all(len(o["results"][0]["issues"]) == 1 for o in outs)
+    # one analysis total; the other three submissions were followers
+    assert stub.calls == 1
+    assert counter("serve_dedupe_hits_total") - hits0 == 3
+
+
+def test_http_deadline_eviction(daemon_factory):
+    gate = threading.Event()
+    stub = StubCampaign(gate=gate)
+    dm, url = daemon_factory(stub=stub)
+    ev0 = counter("serve_evicted_total")
+    # first submission occupies the scheduler (gate held)...
+    s1 = _submit(url, [("busy", b"\x01busy")])
+    time.sleep(0.1)
+    # ...so this one's deadline lapses while QUEUED
+    s2 = _submit(url, [("late", b"\x01late")], deadline_sec=0.05)
+    time.sleep(0.2)
+    gate.set()
+    out = serve_client.get_result(url, s2["id"], wait=20.0)
+    assert out["state"] == "done"
+    assert out["results"][0]["status"] == "evicted"
+    assert counter("serve_evicted_total") - ev0 == 1
+    busy = serve_client.get_result(url, s1["id"], wait=20.0)
+    assert busy["results"][0]["status"] == "ok"
+
+
+def test_http_queue_full_429(daemon_factory):
+    gate = threading.Event()
+    stub = StubCampaign(gate=gate)
+    dm, url = daemon_factory(stub=stub, max_queue=1,
+                             options=ServeOptions(batch_size=1))
+    _submit(url, [("a", b"\x01aa")])          # popped -> running
+    deadline = time.monotonic() + 5.0
+    while dm.queue.depth() == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)                       # wait for the pop
+    _submit(url, [("b", b"\x01bb")])          # queued (depth 1)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _submit(url, [("c", b"\x01cc")])
+    assert exc.value.code == 429
+    gate.set()
+
+
+def test_http_metrics_prometheus_text(daemon_factory):
+    stub = StubCampaign()
+    dm, url = daemon_factory(stub=stub)
+    _submit(url, [("k", ISSUE_CODE)])
+    text = serve_client.metrics(url)
+    line_re = re.compile(
+        r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*"
+        r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE.+-]+)$")
+    lines = [ln for ln in text.splitlines() if ln]
+    assert lines, "empty /metrics"
+    for ln in lines:
+        assert line_re.match(ln), f"bad prometheus line: {ln!r}"
+    assert "mythril_serve_requests_total" in text
+
+
+def test_http_bad_requests(daemon_factory):
+    stub = StubCampaign()
+    dm, url = daemon_factory(stub=stub)
+    for body in (b"{}", b"not json", b'{"contracts": []}',
+                 b'{"code": "zz"}',
+                 b'{"code": "00", "options": {"lanes_per_contract": 1}}'):
+        req = urllib.request.Request(
+            f"{url}/v1/submit", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(f"{url}/v1/result/sXXX", timeout=10)
+    assert exc.value.code == 404
+
+
+def test_graceful_drain_503_and_exactly_once_restart(tmp_path,
+                                                     daemon_factory):
+    """SIGTERM semantics without the signal plumbing: during the drain
+    new submissions get 503 and /healthz says draining; the in-flight
+    batch finishes and persists; a restarted daemon on the same data
+    dir serves the finished verdicts from the store (exactly once) and
+    analyzes only what never committed."""
+    gate = threading.Event()
+    stub = StubCampaign(gate=gate)
+    data_dir = tmp_path / "sdata"
+    dm, url = daemon_factory(stub=stub, data_dir=data_dir,
+                             options=ServeOptions(batch_size=1),
+                             drain_timeout=20.0)
+    s1 = _submit(url, [("done1", ISSUE_CODE)])
+    gate.set()
+    assert serve_client.get_result(url, s1["id"],
+                                   wait=20.0)["state"] == "done"
+    gate.clear()
+    s2 = _submit(url, [("inflight", b"\x01if"), ("queued", b"\x01qq")])
+    # batch_size=1: the scheduler pops 'inflight' (now held by the
+    # gate) and 'queued' stays queued — wait for that split
+    deadline = time.monotonic() + 5.0
+    while dm.queue.depth() != 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    # drain on a helper thread (it blocks on the gated batch)
+    t = threading.Thread(target=dm.shutdown, args=("test",))
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while dm.state != "draining" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert serve_client.healthz(url)["state"] == "draining"
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _submit(url, [("rejected", b"\x01no")])
+    assert exc.value.code == 503
+    gate.set()          # in-flight batch completes during the drain
+    t.join(30.0)
+    assert dm.state == "stopped"
+    assert s2["contracts"] == 2
+    # restart on the same data dir with a FRESH stub: the committed
+    # verdicts (done1, inflight) must come from the store; 'queued'
+    # was failed by the drain and must re-analyze
+    stub2 = StubCampaign()
+    dm2, url2 = daemon_factory(stub=stub2, data_dir=data_dir)
+    snap = _submit(url2, [("done1", ISSUE_CODE), ("inflight", b"\x01if"),
+                          ("queued", b"\x01qq")])
+    out = serve_client.get_result(url2, snap["id"], wait=20.0)
+    assert out["state"] == "done"
+    by = {r["name"]: r for r in out["results"]}
+    assert by["done1"]["served_from"] == "dedupe-store"
+    assert by["inflight"]["served_from"] == "dedupe-store"
+    assert "served_from" not in by["queued"]
+    assert [[n.split("@")[0] for n in b]
+            for b in stub2.batches] == [["queued"]]  # only the lost work
+    for r in by.values():
+        assert len(r["issues"]) == 1       # same verdicts, exactly once
+
+
+def test_quarantined_contract_not_cached(daemon_factory):
+    stub = StubCampaign()
+    dm, url = daemon_factory(stub=stub)
+    snap = _submit(url, [("bad", POISON_CODE)])
+    out = serve_client.get_result(url, snap["id"], wait=20.0)
+    assert out["results"][0]["status"] == "quarantined"
+    assert "stub poison" in out["results"][0]["error"]
+    # poison verdicts are NOT stored: a resubmit re-analyzes
+    snap2 = _submit(url, [("bad2", POISON_CODE)])
+    out2 = serve_client.get_result(url, snap2["id"], wait=20.0)
+    assert out2["results"][0]["status"] == "quarantined"
+    assert stub.calls == 2
+
+
+# --- fleet-fed mode -----------------------------------------------------
+
+def test_fleet_feed_daemon_and_follow_worker(tmp_path, daemon_factory):
+    """The daemon fronts a fleet: admitted batches land in a FEED
+    ledger, a --fleet-follow worker (here: an in-process campaign with
+    a stub runner) claims and commits them, and the results stream
+    back through the same resolution path."""
+    from mythril_tpu.mythril.campaign import CorpusCampaign
+
+    fleet = str(tmp_path / "feed")
+    dm, url = daemon_factory(stub=None, fleet_dir=fleet,
+                             options=ServeOptions(batch_size=2))
+
+    def runner(bi, names, codes):
+        return {"issues": [{"contract": n, "swc-id": "106"}
+                           for n, c in zip(names, codes)
+                           if c.startswith(b"\x01")],
+                "paths": len(names), "dropped": 0, "iprof": {}}
+
+    worker = CorpusCampaign(
+        [], batch_size=2, fleet_dir=fleet, fleet_follow=True,
+        lease_ttl=2.0, worker_id="w-test", batch_runner=runner,
+        execution_timeout=60.0)
+    wres = {}
+
+    def run_worker():
+        wres["res"] = worker.run()
+
+    wt = threading.Thread(target=run_worker)
+    wt.start()
+    try:
+        snap = _submit(url, [("k", b"\x01k1"), ("s", b"\x00s1")])
+        out = serve_client.get_result(url, snap["id"], wait=30.0)
+        assert out["state"] == "done"
+        by = {r["name"]: r for r in out["results"]}
+        assert len(by["k"]["issues"]) == 1 and by["s"]["issues"] == []
+        assert by["k"]["issues"][0]["contract"] == "k"
+    finally:
+        dm.shutdown("test")    # closes the feed -> worker drains out
+        wt.join(30.0)
+    assert not wt.is_alive()
+    assert wres["res"].fleet["units"], "worker committed no units"
+    assert wres["res"].contracts == 2
+
+
+# --- end-to-end with the real engine ------------------------------------
+
+def test_e2e_dedupe_and_warm_compile_real_engine(tmp_path):
+    """The acceptance path (ISSUE 7): same contract twice -> identical
+    issues, the second from the dedupe store with no batch run; a
+    distinct same-shape contract -> analyzed WITHOUT recompiling
+    (warm-compile hit; engine compile counter flat)."""
+    opts = ServeOptions(batch_size=2, lanes_per_contract=8,
+                        max_steps=64, transaction_count=1,
+                        modules=["AccidentallyKillable"],
+                        limits_profile="test")
+    dm = AnalysisDaemon(opts, data_dir=str(tmp_path / "sd"), port=0)
+    dm.start()
+    url = f"http://127.0.0.1:{dm.port}"
+    try:
+        k1 = assemble(0, "SELFDESTRUCT")
+        k2 = assemble(2, "SELFDESTRUCT")     # distinct code, same shape
+        hits0 = counter("serve_dedupe_hits_total")
+        warm0 = counter("serve_warm_compile_hits_total")
+
+        first = serve_client.get_result(
+            url, _submit(url, [("orig", k1)])["id"], wait=300.0)
+        assert first["state"] == "done"
+        (r1,) = first["results"]
+        assert r1["status"] == "ok" and len(r1["issues"]) == 1
+        assert r1["issues"][0]["contract"] == "orig"
+        batches_after_first = dm.scheduler.batches_run
+
+        # 1) duplicate bytecode: served from the store, no lane touched
+        second = serve_client.get_result(
+            url, _submit(url, [("dup", k1)])["id"], wait=30.0)
+        (r2,) = second["results"]
+        assert r2["served_from"] == "dedupe-store"
+        assert counter("serve_dedupe_hits_total") - hits0 == 1
+        assert dm.scheduler.batches_run == batches_after_first
+        # identical issues (modulo the display name they re-home to)
+        strip = (lambda i: {k: v for k, v in i.items()
+                            if k != "contract"})
+        assert ([strip(i) for i in r2["issues"]]
+                == [strip(i) for i in r1["issues"]])
+
+        # 2) same-shape distinct contract: no recompile
+        compiles0 = counter("engine_compiles_total")
+        third = serve_client.get_result(
+            url, _submit(url, [("fresh", k2)])["id"], wait=300.0)
+        (r3,) = third["results"]
+        assert r3["status"] == "ok" and len(r3["issues"]) == 1
+        assert "served_from" not in r3
+        assert counter("serve_warm_compile_hits_total") - warm0 >= 1
+        assert counter("engine_compiles_total") == compiles0
+    finally:
+        dm.shutdown("test")
+    assert dm.state == "stopped"

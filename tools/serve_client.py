@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Stdlib client for the analysis daemon (docs/serving.md).
+
+Submits bytecode (a corpus dir, hex files, or inline hex) to a running
+``mythril_tpu serve`` instance, streams per-contract results as they
+commit, and prints latency percentiles — the operator's smoke test, the
+serve soak leg's driver, and the API example the docs reference.
+
+    python tools/serve_client.py --url http://127.0.0.1:8780 \
+        --corpus ./corpus --stream
+    python tools/serve_client.py --url http://127.0.0.1:8780 \
+        --code 6001600055 --wait 30
+
+Importable pieces (used by tests/test_serve.py and the soak):
+``submit()``, ``get_result()``, ``stream_results()``, ``metrics()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+
+def _post(url: str, doc: Dict, timeout: float = 30.0) -> Dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def submit(base_url: str, contracts: Sequence[Tuple[str, bytes]],
+           tenant: str = "default", priority: int = 0,
+           deadline_sec: Optional[float] = None,
+           options: Optional[Dict] = None,
+           timeout: float = 30.0) -> Dict:
+    """POST /v1/submit. Returns the submission snapshot (id +
+    already-deduped results). Raises ``urllib.error.HTTPError`` on
+    429 (queue full) / 503 (draining)."""
+    doc: Dict = {
+        "contracts": [{"name": n, "code": c.hex()}
+                      for n, c in contracts],
+        "tenant": tenant, "priority": priority,
+    }
+    if deadline_sec is not None:
+        doc["deadline_sec"] = deadline_sec
+    if options:
+        doc["options"] = options
+    return _post(base_url.rstrip("/") + "/v1/submit", doc, timeout)
+
+
+def get_result(base_url: str, sid: str, wait: float = 0.0,
+               timeout: Optional[float] = None) -> Dict:
+    """GET /v1/result/<id>, long-polling ``wait`` seconds for
+    completion."""
+    url = f"{base_url.rstrip('/')}/v1/result/{sid}"
+    if wait:
+        url += f"?wait={wait:g}"
+    with urllib.request.urlopen(
+            url, timeout=timeout if timeout is not None
+            else max(wait + 10.0, 30.0)) as resp:
+        return json.load(resp)
+
+
+def stream_results(base_url: str, sid: str,
+                   timeout: float = 300.0) -> Iterator[Dict]:
+    """GET /v1/result/<id>?stream=1 — yields one dict per contract
+    result IN COMMIT ORDER, then the final ``{"done": true}`` marker."""
+    url = f"{base_url.rstrip('/')}/v1/result/{sid}?stream=1"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        for line in resp:  # http.client decodes the chunked framing
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+
+def metrics(base_url: str) -> str:
+    """GET /metrics (Prometheus text)."""
+    with urllib.request.urlopen(base_url.rstrip("/") + "/metrics",
+                                timeout=30.0) as resp:
+        return resp.read().decode()
+
+
+def healthz(base_url: str) -> Dict:
+    with urllib.request.urlopen(base_url.rstrip("/") + "/healthz",
+                                timeout=30.0) as resp:
+        return json.load(resp)
+
+
+def load_contracts(args) -> List[Tuple[str, bytes]]:
+    out: List[Tuple[str, bytes]] = []
+    for hexcode in args.code or []:
+        out.append((f"inline_{len(out)}",
+                    bytes.fromhex(hexcode.removeprefix("0x"))))
+    for path in args.files or []:
+        with open(path) as fh:
+            out.append((os.path.basename(path).rsplit(".", 1)[0],
+                        bytes.fromhex(
+                            fh.read().strip().removeprefix("0x"))))
+    if args.corpus:
+        for fn in sorted(os.listdir(args.corpus)):
+            if not fn.endswith((".hex", ".bin", ".bin-runtime")):
+                continue
+            with open(os.path.join(args.corpus, fn)) as fh:
+                text = fh.read().strip()
+            if text:
+                out.append((fn.rsplit(".", 1)[0],
+                            bytes.fromhex(text.removeprefix("0x"))))
+    return out
+
+
+def percentiles(xs: Sequence[float]) -> Dict[str, float]:
+    if not xs:
+        return {}
+    s = sorted(xs)
+
+    def pct(p: float) -> float:
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    return {"p50": round(pct(0.50), 4), "p90": round(pct(0.90), 4),
+            "p99": round(pct(0.99), 4), "max": round(s[-1], 4)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True,
+                    help="daemon base URL, e.g. http://127.0.0.1:8780")
+    ap.add_argument("--corpus", metavar="DIR",
+                    help="submit every *.hex/*.bin under DIR")
+    ap.add_argument("--files", nargs="*", metavar="PATH",
+                    help="hex bytecode files to submit")
+    ap.add_argument("--code", nargs="*", metavar="HEX",
+                    help="inline hex bytecodes to submit")
+    ap.add_argument("--tenant", default="cli")
+    ap.add_argument("--priority", type=int, default=0)
+    ap.add_argument("--deadline", type=float, default=None,
+                    metavar="SEC")
+    ap.add_argument("--options", metavar="JSON", default=None,
+                    help='per-request overrides, e.g. '
+                         '\'{"max_steps": 128}\'')
+    ap.add_argument("--stream", action="store_true",
+                    help="stream results as they commit (default: one "
+                         "long-poll)")
+    ap.add_argument("--wait", type=float, default=300.0,
+                    help="long-poll budget in seconds (default 300)")
+    args = ap.parse_args()
+
+    contracts = load_contracts(args)
+    if not contracts:
+        ap.error("nothing to submit: give --corpus, --files or --code")
+    options = json.loads(args.options) if args.options else None
+
+    t0 = time.monotonic()
+    try:
+        snap = submit(args.url, contracts, tenant=args.tenant,
+                      priority=args.priority,
+                      deadline_sec=args.deadline, options=options)
+    except urllib.error.HTTPError as e:
+        print(f"error: submit failed: HTTP {e.code} "
+              f"{e.read().decode()[:300]}", file=sys.stderr)
+        return 1
+    sid = snap["id"]
+    t_submit = time.monotonic() - t0
+    print(f"submitted {snap['contracts']} contract(s) as {sid} "
+          f"({snap['completed']} already served from dedupe)",
+          file=sys.stderr)
+
+    lat: List[float] = []
+    results: List[Dict] = []
+    if args.stream:
+        for rec in stream_results(args.url, sid, timeout=args.wait):
+            if rec.get("done"):
+                break
+            lat.append(time.monotonic() - t0)
+            results.append(rec)
+            issues = rec.get("issues") or []
+            print(f"  {rec.get('name')}: {rec.get('status')} "
+                  f"({len(issues)} issue(s)"
+                  + (f", {rec['served_from']}"
+                     if rec.get("served_from") else "")
+                  + ")", file=sys.stderr)
+    else:
+        snap = get_result(args.url, sid, wait=args.wait)
+        results = snap["results"]
+        lat = [time.monotonic() - t0] * len(results)
+        if snap["state"] != "done":
+            print(f"warning: timed out with {len(results)}/"
+                  f"{snap['contracts']} results", file=sys.stderr)
+
+    done = sum(1 for r in results if r.get("status") == "ok")
+    out = {
+        "id": sid,
+        "contracts": len(contracts),
+        "completed": len(results),
+        "ok": done,
+        "issues": sum(len(r.get("issues") or []) for r in results),
+        "dedupe_served": sum(1 for r in results
+                             if r.get("served_from",
+                                      "").startswith("dedupe")),
+        "submit_sec": round(t_submit, 4),
+        "latency": percentiles(lat),
+        "results": results,
+    }
+    print(json.dumps(out, indent=1))
+    return 0 if len(results) == len(contracts) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,7 +40,15 @@ Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
      RECLAIM the orphaned unit and finish the corpus; the merged
      report (surviving worker + the ledger's committed units) must
      show 100% analyzed+quarantined coverage, zero lost, no
-     double-counted issues, and the lease_reclaimed event on record.
+     double-counted issues, and the lease_reclaimed event on record;
+  9. serve — the always-on daemon (docs/serving.md) as a real
+     subprocess: submit the corpus, let batch 0 commit its verdicts to
+     the store, then SIGTERM the daemon while batch 1 is IN FLIGHT
+     (an injected hang holds it); the bounded drain must exit anyway,
+     and a restarted daemon given the same data dir must serve the
+     completed contracts from the dedupe store (serve_dedupe_hits_total
+     == 2, served_from == dedupe-store) and analyze only the rest —
+     every contract exactly once, the same issue set as a batch run.
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
@@ -98,7 +106,7 @@ SAFE = assemble(1, 0, "SSTORE", "STOP")
 N = 6  # even indices killable -> expected issues c000/c002/c004
 
 LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry",
-        "pipeline", "fleet")
+        "pipeline", "fleet", "serve")
 
 
 def write_corpus(d: str) -> str:
@@ -375,6 +383,112 @@ def main() -> int:
                    and cov.get("full") is True
                    and cov.get("analyzed") == N and not cov.get("lost")
                    and merged.get("issues") == 3   # nothing twice
+                   and issues == ["c000", "c002", "c004"])
+
+        if "serve" in want:
+            # leg 9: kill the resident daemon mid-batch, restart, and
+            # prove exactly-once via the dedupe store. The daemon runs
+            # as a REAL subprocess (signals, drain, process death are
+            # the contract under test); batch 1 is held by an injected
+            # hang so SIGTERM provably lands during an in-flight batch
+            # and the bounded drain (--drain-timeout) must abandon it.
+            import re
+            import signal
+            import subprocess
+            import time as _time
+
+            sys.path.insert(0, os.path.join(ROOT, "tools"))
+            import serve_client
+
+            # six DISTINCT bytecodes (the shared soak corpus has only
+            # two: odd/even contracts are byte-clones, which the
+            # admission dedupe collapses into one batch — correct for
+            # serving, useless for a kill-mid-batch scenario). Varying
+            # the pushed operand keeps even contracts killable while
+            # making every bytecode hash unique, so the daemon really
+            # runs 3 batches of 2.
+            contracts = [
+                (f"c{i:03d}",
+                 assemble(i, "SELFDESTRUCT") if i % 2 == 0
+                 else assemble(1, i, "SSTORE", "STOP"))
+                for i in range(N)]
+            dd = os.path.join(d, "serve_data")
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+            def start_daemon(tag, fault=None):
+                pf = os.path.join(d, f"port_{tag}")
+                cmd = [sys.executable, "-m", "mythril_tpu", "serve",
+                       "--port", "0", "--port-file", pf,
+                       "--data-dir", dd, "--batch-size", "2",
+                       "--lanes-per-contract", "8",
+                       "--max-steps", "64", "-t", "1",
+                       "-m", "AccidentallyKillable",
+                       "--limits-profile", "test",
+                       "--drain-timeout", "2"]
+                if fault:
+                    cmd += ["--fault-inject", fault]
+                proc = subprocess.Popen(cmd, env=env, cwd=ROOT,
+                                        stderr=subprocess.DEVNULL)
+                deadline = _time.monotonic() + 120
+                while not os.path.exists(pf):
+                    if (proc.poll() is not None
+                            or _time.monotonic() > deadline):
+                        raise RuntimeError("serve daemon failed to start")
+                    _time.sleep(0.1)
+                with open(pf) as fh:
+                    return proc, f"http://127.0.0.1:{fh.read().strip()}"
+
+            p1, url1 = start_daemon("a", fault="hang:batch=1")
+            sid1 = serve_client.submit(url1, contracts,
+                                       tenant="soak")["id"]
+            # wait for batch 0's two verdicts to commit durably; batch
+            # 1 then hangs — the in-flight window we SIGTERM into
+            committed = 0
+            deadline = _time.monotonic() + 300
+            while committed < 2 and _time.monotonic() < deadline:
+                committed = serve_client.get_result(
+                    url1, sid1, wait=2.0)["completed"]
+            p1.send_signal(signal.SIGTERM)
+            rc1 = p1.wait(timeout=120)
+
+            p2, url2 = start_daemon("b")
+            try:
+                snap = serve_client.submit(url2, contracts,
+                                           tenant="soak")
+                final = serve_client.get_result(url2, snap["id"],
+                                                wait=300.0)
+                met = serve_client.metrics(url2)
+            finally:
+                p2.send_signal(signal.SIGTERM)
+                p2.wait(timeout=120)
+            mdedupe = re.search(
+                r"^mythril_serve_dedupe_hits_total (\d+)", met,
+                re.MULTILINE)
+            dedupe_hits = int(mdedupe.group(1)) if mdedupe else -1
+            results = final["results"]
+            by_name = {}
+            for r in results:
+                by_name.setdefault(r["name"], []).append(r)
+            issues = sorted(i["contract"] for r in results
+                            for i in (r.get("issues") or []))
+            from_store = sorted(
+                r["name"] for r in results
+                if r.get("served_from") == "dedupe-store")
+            legs["serve"] = {
+                "pre_kill_committed": committed,
+                "daemon1_rc": rc1,
+                "completed": final["completed"],
+                "state": final["state"],
+                "dedupe_hits": dedupe_hits,
+                "from_store": from_store,
+                "issues": issues,
+            }
+            ok &= (committed == 2 and rc1 == 0
+                   and final["state"] == "done"
+                   and final["completed"] == N
+                   and all(len(v) == 1 for v in by_name.values())
+                   and dedupe_hits == 2
+                   and from_store == ["c000", "c001"]
                    and issues == ["c000", "c002", "c004"])
 
     print(json.dumps({"ok": bool(ok), "legs": legs}))
