@@ -54,7 +54,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .obs import metrics as obs_metrics
 from .obs import trace as obs_trace
-from .utils.checkpoint import durable_write, fsync_dir
+from .utils.checkpoint import durable_write, exclusive_write, fsync_dir
 
 #: on-disk manifest schema (bump on breaking layout changes; readers
 #: reject newer-than-known versions)
@@ -78,31 +78,9 @@ def corpus_fingerprint(contracts: Sequence[tuple]) -> str:
     return h.hexdigest()[:16]
 
 
-def _exclusive_write(path: str, data: bytes) -> bool:
-    """Atomically create ``path`` with ``data`` IFF it does not already
-    exist: tmp file + fsync + ``os.link`` (which fails with EEXIST
-    instead of overwriting, unlike rename). Returns whether this caller
-    won — the primitive behind first-commit-wins and create-once
-    manifests. The tmp name carries pid AND thread id so in-process
-    fleets (threaded workers) never collide."""
-    tmp = f"{path}.{os.getpid()}-{threading.get_ident()}.tmp"
-    with open(tmp, "wb") as fh:
-        fh.write(data)
-        fh.flush()
-        os.fsync(fh.fileno())
-    try:
-        os.link(tmp, path)
-        won = True
-    except FileExistsError:
-        won = False
-    finally:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-    if won:
-        fsync_dir(path)
-    return won
+# first-commit-wins / create-once primitive: now shared repo-wide from
+# utils/checkpoint.py (the solver verdict store uses it too)
+_exclusive_write = exclusive_write
 
 
 @dataclass

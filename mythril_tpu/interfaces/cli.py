@@ -223,6 +223,17 @@ def create_parser() -> argparse.ArgumentParser:
                    help="fleet mode: stable worker identity stamped "
                         "into leases and unit results (default: "
                         "hostname-pid-tid)")
+    a.add_argument("--solver-store", metavar="DIR",
+                   help="shared per-QUERY solver verdict store "
+                        "(docs/solver.md): canonical constraint hashes "
+                        "-> durable sat/unsat verdicts, reused across "
+                        "campaigns, fleet workers, and restarts. "
+                        "Default: <fleet-dir>/solver_store under "
+                        "--fleet, off otherwise")
+    a.add_argument("--no-solver-store", action="store_true",
+                   help="disable the solver verdict store (including "
+                        "the --fleet default); the in-process LRU and "
+                        "the refute/probe stages stay on")
     a.add_argument("--fleet-follow", action="store_true",
                    help="fleet mode: join a serve daemon's FEED ledger "
                         "(docs/serving.md) — units carry their own "
@@ -345,6 +356,16 @@ def create_parser() -> argparse.ArgumentParser:
                          "of running locally; workers join with "
                          "'analyze --fleet DIR --fleet-follow' "
                          "(docs/fleet.md, docs/serving.md)")
+    sv.add_argument("--solver-store", metavar="DIR",
+                    help="shared per-QUERY solver verdict store "
+                         "(docs/solver.md); default: "
+                         "<data-dir>/solver_store — the daemon's "
+                         "solver work survives restarts like its "
+                         "per-contract verdicts do")
+    sv.add_argument("--no-solver-store", action="store_true",
+                    help="disable the per-query solver verdict store "
+                         "(the per-contract dedupe store is governed "
+                         "by --no-dedupe, not this flag)")
     sv.add_argument("--batch-size", type=int, default=8,
                     help="contracts per compiled service batch "
                          "(default 8)")
@@ -553,6 +574,12 @@ def _exec_analyze_inner(args) -> int:
 
     from ..mythril import MythrilAnalyzer, MythrilConfig
     from ..symbolic import SymSpec
+    if getattr(args, "solver_store", None) and not args.no_solver_store:
+        # single-shot analyze can still read/feed a shared verdict
+        # store (e.g. the one a nightly campaign maintains)
+        from ..smt import portfolio as smt_portfolio
+
+        smt_portfolio.set_store(args.solver_store)
     contracts = _load_contracts(args)
     if args.code and args.creation_code:
         with open(args.creation_code) as fh:
@@ -787,6 +814,10 @@ def _exec_campaign(args) -> int:
         max_unit_leases=args.max_unit_leases,
         worker_id=args.worker_id,
         fleet_follow=fleet_follow,
+        # "auto" lets the campaign apply the fleet default
+        # (<fleet-dir>/solver_store); --no-solver-store beats both
+        solver_store=(None if args.no_solver_store
+                      else (args.solver_store or "auto")),
     )
 
     unit_word = "unit" if args.fleet else "batch"
@@ -840,7 +871,9 @@ def exec_serve(args) -> int:
     daemon = AnalysisDaemon(
         opts, data_dir=args.data_dir, host=args.host, port=args.port,
         dedupe=args.dedupe, max_queue=args.max_queue,
-        drain_timeout=args.drain_timeout, fleet_dir=args.fleet)
+        drain_timeout=args.drain_timeout, fleet_dir=args.fleet,
+        solver_store=(None if args.no_solver_store
+                      else (args.solver_store or "auto")))
     daemon.install_signal_handlers()
     try:
         daemon.start()

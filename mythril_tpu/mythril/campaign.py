@@ -123,6 +123,9 @@ class CampaignResult:
     # the ledger's lost list, and the manifest merge_campaigns needs for
     # exactly-once accounting + the coverage manifest
     fleet: Dict = field(default_factory=dict)
+    # staged solver-portfolio session delta (docs/solver.md): per-stage
+    # attempts/hits/latency + the Z3-avoided headline
+    solver_portfolio: Dict = field(default_factory=dict)
 
     def as_dict(self) -> Dict:
         # rates derive from the per-batch wall times, which the
@@ -162,6 +165,8 @@ class CampaignResult:
             "backend_events": self.backend_events,
             **({"iprof": self.iprof} if self.iprof else {}),
             **({"fleet": self.fleet} if self.fleet else {}),
+            **({"solver_portfolio": self.solver_portfolio}
+               if self.solver_portfolio else {}),
         }
 
 
@@ -204,6 +209,7 @@ class CorpusCampaign:
         max_unit_leases: int = 3,
         worker_id: Optional[str] = None,
         fleet_follow: bool = False,
+        solver_store: Optional[str] = "auto",
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -326,6 +332,18 @@ class CorpusCampaign:
         # a FEED — units (with their bytecode) arrive over time from a
         # serve daemon instead of being cut from a local corpus
         self.fleet_follow = bool(fleet_follow)
+        # staged solver portfolio (docs/solver.md): a shared per-QUERY
+        # verdict-store directory. "auto" = on by default under a fleet
+        # ledger (every worker shares <fleet_dir>/solver_store — solver
+        # work crosses hosts like unit results do); otherwise off
+        # unless --solver-store names a dir. The run scopes the
+        # process-global store and restores the previous one on exit,
+        # so back-to-back campaigns (tests, the serve scheduler's
+        # resident instances) never leak stores into each other.
+        if solver_store == "auto":
+            solver_store = (os.path.join(fleet_dir, "solver_store")
+                            if fleet_dir is not None else None)
+        self.solver_store = solver_store
         # cross-batch warm-compile accounting: one chunk-shape set per
         # ENGINE shape class (batch width, lanes, step budget, tx
         # count), shared by every SymExecWrapper of that class — batch
@@ -334,6 +352,9 @@ class CorpusCampaign:
         # compile counter / cold spans / pacing stop re-counting it
         self._warm_shapes: Dict[tuple, set] = {}
         self._extern_batches = 0
+        # portfolio-stats baseline for this run's deltas (heartbeat
+        # Z3-avoided %, per-batch solver_portfolio events, the report)
+        self._pstats0: Optional[Dict] = None
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -615,6 +636,9 @@ class CorpusCampaign:
         reg.counter("batch_retries_total").inc(out["retries"])
         reg.counter("contracts_quarantined_total").inc(
             len(out["quarantined"]))
+        from ..smt.solver import SOLVER_STATS
+
+        self._portfolio_event(SOLVER_STATS.as_dict())
         out["wall_sec"] = sp.elapsed
         out["batch"] = bi
         return out
@@ -871,11 +895,38 @@ class CorpusCampaign:
         out["status"] = f"quarantined:{len(out['quarantined'])}"
         return out
 
+    def _portfolio_delta(self) -> Dict:
+        """This run's solver-portfolio delta (daemon-lifetime totals
+        when no run() baseline exists, e.g. resident serve batches)."""
+        from ..smt import portfolio as smt_portfolio
+
+        return smt_portfolio.stats_delta(
+            smt_portfolio.PORTFOLIO_STATS.snapshot(), self._pstats0)
+
+    def _portfolio_event(self, solver_totals: Optional[Dict]) -> None:
+        """Emit the cumulative per-stage solver-portfolio counters as
+        one trace event (batch-commit cadence — trace_report sections
+        7/8 read the LAST one, so cumulative beats per-batch deltas)."""
+        if not obs_trace.active():
+            return
+        d = self._portfolio_delta()
+        t = solver_totals or {}
+        obs_trace.event("solver_portfolio",
+                        queries=d["queries"],
+                        z3_avoided_pct=d["z3_avoided_pct"],
+                        witness_mismatch=d["witness_mismatch"],
+                        stages=d["stages"],
+                        attempts=t.get("attempts", 0),
+                        sat=t.get("sat", 0), unsat=t.get("unsat", 0),
+                        unknown=t.get("unknown", 0))
+
     def _heartbeat(self, done: int, total: int, res: "CampaignResult",
                    last_out: Dict) -> None:
         """One line of live progress on stderr (plus a ``heartbeat``
         event on the trace bus): contracts done, paths/s, frontier
-        occupancy, current rung, last-checkpoint age. The 10k-campaign
+        occupancy, current rung, Z3-avoided %% (the share of solver
+        queries the portfolio resolved before the witness search —
+        docs/solver.md), last-checkpoint age. The 10k-campaign
         operator's 'is it still making progress, and at what cost'
         pulse — without grepping four channels."""
         wall = sum(res.batch_wall)
@@ -888,17 +939,20 @@ class CorpusCampaign:
             cap = max(1, self.batch_size * self.lanes_per_contract)
             occ = min(1.0, last_out.get("paths", 0) / cap)
         rung = res.batch_status[-1] if res.batch_status else "-"
+        z3av = self._portfolio_delta()["z3_avoided_pct"]
         age = (time.monotonic() - self._last_ckpt_mono
                if self._last_ckpt_mono is not None else None)
         age_s = f"{age:.1f}s" if age is not None else "never"
         print(f"heartbeat: batch {done}/{total} contracts {contracts}/"
               f"{len(self.contracts)} paths/s {pps:.1f} frontier "
-              f"{100.0 * occ:.0f}% rung {rung} ckpt-age {age_s}",
+              f"{100.0 * occ:.0f}% rung {rung} z3-avoid {z3av:.0f}% "
+              f"ckpt-age {age_s}",
               file=sys.stderr, flush=True)
         obs_trace.event("heartbeat", batch=done, batches_total=total,
                         contracts=contracts,
                         paths_per_sec=round(pps, 1),
                         occupancy=round(occ, 4), rung=rung,
+                        z3_avoided_pct=z3av,
                         ckpt_age=(round(age, 3) if age is not None
                                   else None))
 
@@ -1074,9 +1128,11 @@ class CorpusCampaign:
         when the deadline expired mid-unit (the lease is released so
         another worker picks the unit up without burning a re-lease
         grant)."""
+        from ..smt import portfolio as smt_portfolio
         from ..smt.solver import SOLVER_STATS
 
         stats0 = SOLVER_STATS.snapshot()
+        pstats0 = smt_portfolio.PORTFOLIO_STATS.snapshot()
         rec: Dict = {"unit": unit.uid, "attempt": unit.attempt,
                      "worker": ledger.worker, "corpus": ledger.corpus,
                      "contracts": list(unit.names),
@@ -1127,6 +1183,13 @@ class CorpusCampaign:
                     rec["iprof"][k] = rec["iprof"].get(k, 0) + v
         rec["solver"] = {k: round(v, 3)
                          for k, v in SOLVER_STATS.delta(stats0).items()}
+        # the unit record carries its portfolio delta too (numeric-only
+        # merge arithmetic skips the nested dict; it rides for audit)
+        from ..smt import portfolio as smt_portfolio
+
+        rec["solver_portfolio"] = smt_portfolio.stats_delta(
+            smt_portfolio.PORTFOLIO_STATS.snapshot(), pstats0)
+        self._portfolio_event(rec["solver"])
         return rec
 
     def _fleet_absorb(self, res: CampaignResult, rec: Dict) -> None:
@@ -1152,13 +1215,15 @@ class CorpusCampaign:
         self._last_beat = now
         wall = sum(res.batch_wall)
         pps = res.paths_total / wall if wall else 0.0
+        z3av = self._portfolio_delta()["z3_avoided_pct"]
         print(f"heartbeat: unit {rec['unit']} committed "
               f"({len(res.fleet['units'])} by this worker), "
-              f"paths/s {pps:.1f}",
+              f"paths/s {pps:.1f} z3-avoid {z3av:.0f}%",
               file=sys.stderr, flush=True)
         obs_trace.event("heartbeat", unit=rec["unit"],
                         units_committed=len(res.fleet["units"]),
-                        paths_per_sec=round(pps, 1))
+                        paths_per_sec=round(pps, 1),
+                        z3_avoided_pct=z3av)
 
     def _run_fleet(self, progress=None) -> CampaignResult:
         """Claim→run→commit loop against the shared work ledger
@@ -1250,10 +1315,32 @@ class CorpusCampaign:
 
     # --- the campaign --------------------------------------------------
     def run(self, progress=None) -> CampaignResult:
+        """Run the campaign (static slice or fleet loop), with the
+        solver-portfolio store scoped to the run: the configured store
+        directory becomes the process-global verdict store for the
+        duration and the previous one is restored afterwards (even
+        across a simulated kill), so concurrent owners — a serve
+        daemon's data-dir store, another test's tmp dir — are never
+        clobbered."""
+        from ..smt import portfolio as smt_portfolio
+
+        prev_store = (smt_portfolio.set_store(self.solver_store)
+                      if self.solver_store else None)
+        self._pstats0 = smt_portfolio.PORTFOLIO_STATS.snapshot()
+        try:
+            res = (self._run_fleet(progress)
+                   if self.fleet_dir is not None
+                   else self._run_static(progress))
+        finally:
+            if self.solver_store:
+                smt_portfolio.set_store(prev_store)
+        res.solver_portfolio = smt_portfolio.stats_delta(
+            smt_portfolio.PORTFOLIO_STATS.snapshot(), self._pstats0)
+        return res
+
+    def _run_static(self, progress=None) -> CampaignResult:
         from ..smt.solver import SOLVER_STATS
 
-        if self.fleet_dir is not None:
-            return self._run_fleet(progress)
         t_start = time.monotonic()
         deadline = (None if self.execution_timeout is None
                     else t_start + self.execution_timeout)
@@ -1346,6 +1433,9 @@ class CorpusCampaign:
             for k, v in state["solver"].items():
                 if isinstance(v, (int, float)):
                     reg.gauge(f"solver_{k}").set(v)
+            # cumulative portfolio ladder on the trace bus (section 8
+            # of trace_report reads the last of these)
+            self._portfolio_event(state["solver"])
             if progress is not None:
                 progress(bi + 1, n_batches, dt, len(res.issues))
             if self.heartbeat_every is not None:

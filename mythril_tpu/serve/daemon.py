@@ -111,11 +111,19 @@ class AnalysisDaemon:
                  dedupe: bool = True, max_queue: int = 4096,
                  drain_timeout: float = 30.0,
                  fleet_dir: Optional[str] = None,
-                 campaign_factory=None):
+                 campaign_factory=None,
+                 solver_store: Optional[str] = "auto"):
         self.options = options or ServeOptions()
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self.store = ResultsStore(os.path.join(data_dir, "store"))
+        # per-QUERY solver verdict store (docs/solver.md) beside the
+        # per-CONTRACT dedupe store: the daemon's solver work survives
+        # restarts and is shared with any fleet workers it fronts.
+        # "auto" = <data-dir>/solver_store; None disables.
+        if solver_store == "auto":
+            solver_store = os.path.join(data_dir, "solver_store")
+        self.solver_store = solver_store
         self.queue = AdmissionQueue(
             store=self.store, dedupe=dedupe, max_depth=max_queue,
             config_fn=self.options.effective)
@@ -143,6 +151,9 @@ class AnalysisDaemon:
         return self.queue.submit(contracts, **kw)
 
     def health(self) -> Dict:
+        from ..smt import portfolio as smt_portfolio
+
+        vstore = smt_portfolio.get_store()
         return {
             "ok": True,
             "state": self.state,
@@ -150,6 +161,7 @@ class AnalysisDaemon:
             "batches_run": self.scheduler.batches_run,
             "fleet_units_pending": self.scheduler.pending_fleet_units(),
             "store_verdicts": self.store.count(),
+            "solver_verdicts": vstore.count() if vstore else 0,
             "uptime_sec": round(time.monotonic() - self.t_start, 3),
             "pid": os.getpid(),
         }
@@ -164,6 +176,17 @@ class AnalysisDaemon:
     # --- lifecycle ------------------------------------------------------
     def start(self) -> None:
         obs_metrics.REGISTRY.enabled = True  # /metrics is always on
+        if self.solver_store:
+            # resident campaigns run with solver_store=None, so the
+            # daemon-installed store stays in force for every batch;
+            # /metrics exposes the portfolio ladder from the first
+            # scrape (register_metrics inside set_store). The previous
+            # store is restored on shutdown — in-process daemons
+            # (tests) must not leak their store into later work.
+            from ..smt import portfolio as smt_portfolio
+
+            self._prev_solver_store = smt_portfolio.set_store(
+                self.solver_store)
         self.scheduler.start()
         self.httpd = ServeHTTPServer((self.host, self._port), self)
         self._http_thread = threading.Thread(
@@ -206,6 +229,10 @@ class AnalysisDaemon:
         if self.httpd is not None:
             self.httpd.shutdown()
             self.httpd.server_close()
+        if self.solver_store and hasattr(self, "_prev_solver_store"):
+            from ..smt import portfolio as smt_portfolio
+
+            smt_portfolio.set_store(self._prev_solver_store)
         self.state = "stopped"
         obs_trace.event("serve_stopped", reason=reason,
                         queued_failed=failed)

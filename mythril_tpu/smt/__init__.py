@@ -15,9 +15,12 @@ SURVEY.md §2); this image has no Z3, so the stack is self-built:
 from .tape import HostTape, HostNode, extract_tape
 from .eval import Assignment, TxInput, evaluate
 from .solver import Solver, UnsatError, solve_lane
+from .canon import canonical_digest, canonical_query
+from .vstore import VerdictStore
 
 __all__ = [
     "HostTape", "HostNode", "extract_tape",
     "Assignment", "TxInput", "evaluate",
     "Solver", "UnsatError", "solve_lane",
+    "canonical_digest", "canonical_query", "VerdictStore",
 ]

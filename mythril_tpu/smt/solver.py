@@ -408,17 +408,22 @@ def _mutate_leaf(tape: HostTape, leaf: int, asn: Assignment, rng: random.Random)
 
 
 #: memoized solve front door (reference: ``support/model.py get_model``'s
-#: lru cache ⚠unv, SURVEY §2 "Model cache"). Key = full structural
-#: fingerprint + search budget; a TRUE LRU (hits refresh recency) capped
-#: at ``_SOLVE_CACHE_CAP`` so a 10k-contract campaign — whose dispatcher
-#: queries recur heavily within a batch but churn across the corpus —
-#: keeps the hot working set without growing without bound. Caching
+#: lru cache ⚠unv, SURVEY §2 "Model cache"). Key = CANONICAL constraint
+#: hash (``smt/canon.py`` — alpha-renamed repeats from cloned bytecode
+#: share one entry; pre-portfolio this was the raw structural
+#: fingerprint, see docs/solver.md) + search budget; a TRUE LRU (hits
+#: refresh recency) capped at ``_SOLVE_CACHE_CAP`` so a 10k-contract
+#: campaign — whose dispatcher queries recur heavily within a batch but
+#: churn across the corpus — keeps the hot working set without growing
+#: without bound. Values are ``(verdict, canonical witness doc | None)``
+#: — sat witnesses travel in renaming-independent coordinates and are
+#: rehydrated + re-verified per hit by ``smt/portfolio.py``. Caching
 #: `unknown` is safe because the budget is in the key. The cap is
 #: configurable via :func:`set_solve_cache_cap` or the
 #: ``MYTHRIL_SOLVE_CACHE_CAP`` env var (0 disables caching); size and
 #: eviction totals are published as ``solver_cache_size`` /
 #: ``solver_cache_evictions_total`` in the metrics registry.
-_SOLVE_CACHE: "OrderedDict[tuple, Tuple[str, Optional[Assignment]]]" = \
+_SOLVE_CACHE: "OrderedDict[tuple, Tuple[str, Optional[dict]]]" = \
     OrderedDict()
 _SOLVE_CACHE_CAP = int(os.environ.get("MYTHRIL_SOLVE_CACHE_CAP", "") or 8192)
 _SOLVE_CACHE_LOCK = threading.Lock()
@@ -451,73 +456,30 @@ def _cache_evict_locked() -> None:
         help="entries in the solve memo cache").set(len(_SOLVE_CACHE))
 
 
-def _fingerprint(tape: HostTape, seed: int, max_iters: int,
-                 max_time: Optional[float]) -> tuple:
-    return (
-        tuple((nd.op, nd.a, nd.b, nd.imm) for nd in tape.nodes),
-        tuple((int(n), bool(s)) for n, s in tape.constraints),
-        seed, max_iters, max_time,
-    )
-
-
 def solve_tape_ex(tape: HostTape, seed: int = 0, max_iters: int = 400,
                   base: Optional[Assignment] = None,
                   max_time: Optional[float] = None
                   ) -> Tuple[str, Optional[Assignment]]:
     """(verdict, assignment) with verdict in {"sat", "unsat", "unknown"}.
 
-    Three-verdict pipeline (VERDICT r3 ask #4): the memo cache first, then
-    a structural refutation pass (proven UNSAT is recorded distinctly from
-    search-exhausted UNKNOWN in ``SOLVER_STATS``), then the witness
-    search. ``base``-seeded queries skip the cache (the assignment is an
-    input the fingerprint does not cover). ``max_time`` is a per-query
-    wall-clock budget in seconds (reference: ``--solver-timeout`` ms ⚠unv)
-    checked between repair iterations; expiry returns unknown, same
-    degrade-to-no-issue semantics as an exhausted iteration budget."""
-    from .refute import refute_tape
+    Front door over the staged solver portfolio (``smt/portfolio.py``,
+    docs/solver.md): canonical-hash LRU → structural refutation →
+    model probe → durable cross-campaign verdict store → the witness
+    search below. Proven UNSAT is recorded distinctly from
+    search-exhausted UNKNOWN in ``SOLVER_STATS`` (VERDICT r3 ask #4);
+    per-stage attempt/hit/latency lands in
+    ``portfolio.PORTFOLIO_STATS`` and the metrics registry.
+    ``base``-seeded queries skip every cache (the seed assignment is an
+    input the canonical hash does not cover) and run refute → probe →
+    search only. ``max_time`` is a per-query wall-clock budget in
+    seconds (reference: ``--solver-timeout`` ms ⚠unv) checked between
+    repair iterations; expiry returns unknown — same
+    degrade-to-no-issue semantics as an exhausted iteration budget —
+    and is never cached."""
+    from .portfolio import solve_query
 
-    t0 = time.perf_counter()
-    deadline = None if max_time is None else t0 + max_time
-    key = None
-    if base is None and _SOLVE_CACHE_CAP > 0:
-        key = _fingerprint(tape, seed, max_iters, max_time)
-        with _SOLVE_CACHE_LOCK:
-            hit = _SOLVE_CACHE.get(key)
-            if hit is not None:
-                # a hit is a *use*: refresh recency so the corpus's hot
-                # recurring queries (dispatcher/require structure) stay
-                # resident while one-off fingerprints age out
-                _SOLVE_CACHE.move_to_end(key)
-        if hit is not None:
-            verdict, asn = hit
-            SOLVER_STATS.record(verdict, time.perf_counter() - t0,
-                                cached=True)
-            return verdict, (asn.copy() if asn is not None else None)
-
-    if refute_tape(tape) is not None:
-        verdict, out = "unsat", None
-    else:
-        verdict, out = _solve_partitioned(tape, seed, max_iters, base,
-                                          deadline)
-    if verdict == "unknown":
-        _dump_unknown(tape)
-    if (verdict == "unknown" and deadline is not None
-            and time.perf_counter() >= deadline):
-        # a wall-clock expiry is load-dependent, not a property of the
-        # query — caching it would permanently poison this fingerprint
-        # for re-queries issued after contention subsides
-        key = None
-    if key is not None:
-        # lock, not tolerant-race: --parallel-solving module threads and
-        # the campaign's pipelined host phase both insert concurrently,
-        # and an OrderedDict's relink is not atomic under mutation
-        with _SOLVE_CACHE_LOCK:
-            _SOLVE_CACHE[key] = (verdict,
-                                 out.copy() if out is not None else None)
-            _SOLVE_CACHE.move_to_end(key)
-            _cache_evict_locked()
-    SOLVER_STATS.record(verdict, time.perf_counter() - t0)
-    return verdict, out
+    return solve_query(tape, seed=seed, max_iters=max_iters, base=base,
+                       max_time=max_time)
 
 
 def solve_tape(tape: HostTape, seed: int = 0, max_iters: int = 400,

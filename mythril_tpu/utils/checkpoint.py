@@ -136,6 +136,37 @@ def durable_write(path: str, data: bytes, rotate: bool = True) -> None:
     fsync_dir(path)
 
 
+def exclusive_write(path: str, data: bytes) -> bool:
+    """Atomically create ``path`` with ``data`` IFF it does not already
+    exist: tmp file + fsync + ``os.link`` (which fails with EEXIST
+    instead of overwriting, unlike rename). Returns whether this caller
+    won — the first-wins primitive behind fleet unit commits
+    (mythril_tpu/fleet.py), create-once manifests, and the solver
+    verdict store (mythril_tpu/smt/vstore.py). The tmp name carries pid
+    AND thread id so in-process fleets (threaded workers) never
+    collide."""
+    import threading
+
+    tmp = f"{path}.{os.getpid()}-{threading.get_ident()}.tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    try:
+        os.link(tmp, path)
+        won = True
+    except FileExistsError:
+        won = False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    if won:
+        fsync_dir(path)
+    return won
+
+
 # --- frontier (npz) checkpoints ---------------------------------------
 
 
@@ -496,7 +527,8 @@ def load_json_checkpoint_resilient(
 
 __all__ = [
     "BackgroundCheckpointWriter", "CHECKPOINT_SCHEMA", "CheckpointCorrupt",
-    "ROTATE_SUFFIX", "durable_write", "fsync_dir", "load_frontier",
+    "ROTATE_SUFFIX", "durable_write", "exclusive_write", "fsync_dir",
+    "load_frontier",
     "load_frontier_resilient", "load_json_checkpoint",
     "load_json_checkpoint_resilient", "save_frontier",
     "save_json_checkpoint",

@@ -1,0 +1,362 @@
+"""Solver portfolio (docs/solver.md): canonical constraint hashing,
+the durable cross-campaign verdict store, and the staged
+refute -> probe -> store -> LRU -> search pipeline.
+
+The contracts under test:
+
+- canonicalization invariance: alpha-renamed / reordered /
+  operand-swapped constraint sets hash EQUAL; semantically different
+  sets (sign flips, different constants, different variable coupling)
+  hash apart;
+- vstore durability semantics: corruption is a counted miss (and the
+  corrupt file is cleared for rewrite), concurrent writers are
+  first-wins, `unknown` is never persisted;
+- portfolio parity: campaign issue output is byte-identical with the
+  store disabled, cold, and warm — and on a clone-heavy corpus a warm
+  second campaign resolves >= 50% of its SAT queries before the
+  search stage (the acceptance bar), proven by the per-stage counters;
+- fleet workers share solver work through `<fleet-dir>/solver_store`.
+"""
+
+import json
+import os
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.config import TEST_LIMITS
+from mythril_tpu.disassembler.asm import assemble
+from mythril_tpu.obs import metrics as obs_metrics
+from mythril_tpu.smt import portfolio
+from mythril_tpu.smt.canon import (canonical_query, witness_from_doc,
+                                   witness_ok, witness_to_doc)
+from mythril_tpu.smt.solver import _SOLVE_CACHE, solve_tape_ex
+from mythril_tpu.smt.tape import HostNode, HostTape
+from mythril_tpu.smt.vstore import VerdictStore
+from mythril_tpu.symbolic.ops import FreeKind, SymOp
+
+N = lambda op, a=0, b=0, imm=0: HostNode(int(op), a, b, imm)  # noqa: E731
+
+
+@pytest.fixture(autouse=True)
+def _isolated_portfolio():
+    """Each test starts cache-cold with no process-global store and
+    restores whatever was installed before (nothing, in practice)."""
+    _SOLVE_CACHE.clear()
+    prev = portfolio.set_store(None)
+    yield
+    portfolio.set_store(prev)
+    _SOLVE_CACHE.clear()
+
+
+# --- canonicalization ---------------------------------------------------
+
+def _tape_a():
+    # cd0 == 5  AND  havoc < 9
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),   # 1
+        N(SymOp.CONST, imm=5),                           # 2
+        N(SymOp.EQ, 1, 2),                               # 3
+        N(SymOp.FREE, int(FreeKind.HAVOC), 0),           # 4
+        N(SymOp.CONST, imm=9),                           # 5
+        N(SymOp.LT, 4, 5),                               # 6
+    ]
+    return HostTape(nodes=nodes, constraints=[(3, True), (6, True)])
+
+
+def _tape_a_renamed():
+    # same constraint set: dead node inserted (all ids shift), EQ
+    # operands swapped, constraints reordered, havoc at a new id
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.CONST, imm=777),                         # 1 (dead)
+        N(SymOp.CONST, imm=9),                           # 2
+        N(SymOp.FREE, int(FreeKind.HAVOC), 0),           # 3
+        N(SymOp.LT, 3, 2),                               # 4
+        N(SymOp.CONST, imm=5),                           # 5
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),   # 6
+        N(SymOp.EQ, 5, 6),                               # 7
+    ]
+    return HostTape(nodes=nodes, constraints=[(4, True), (7, True)])
+
+
+def test_canonical_hash_alpha_and_reorder_invariant():
+    c1 = canonical_query(_tape_a())
+    c2 = canonical_query(_tape_a_renamed())
+    assert c1.digest == c2.digest
+    # duplicated constraints are set semantics, not new content
+    t = _tape_a()
+    t.constraints.append(t.constraints[0])
+    assert canonical_query(t).digest == c1.digest
+
+
+def test_canonical_hash_distinguishes_semantics():
+    base = canonical_query(_tape_a()).digest
+    # sign flip
+    t = _tape_a()
+    t.constraints[0] = (3, False)
+    assert canonical_query(t).digest != base
+    # different constant
+    t2 = _tape_a()
+    t2.nodes[2] = N(SymOp.CONST, imm=6)
+    assert canonical_query(t2).digest != base
+    # dropped constraint
+    t3 = _tape_a()
+    t3.constraints = t3.constraints[:1]
+    assert canonical_query(t3).digest != base
+
+
+def test_canonical_hash_preserves_variable_coupling():
+    # EQ(x, x) (valid) vs EQ(x, y) (two distinct havocs) must differ
+    # even though their leaf KINDS are identical — the de Bruijn
+    # numbering is what carries the sharing structure
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.HAVOC), 0),           # 1 (x)
+        N(SymOp.FREE, int(FreeKind.HAVOC), 0),           # 2 (y)
+        N(SymOp.EQ, 1, 2),                               # 3: x == y
+        N(SymOp.EQ, 1, 1),                               # 4: x == x
+    ]
+    txy = HostTape(nodes=nodes, constraints=[(3, True)])
+    txx = HostTape(nodes=nodes, constraints=[(4, True)])
+    assert canonical_query(txy).digest != canonical_query(txx).digest
+
+
+def test_canonical_witness_roundtrip_across_variants():
+    t1, t2 = _tape_a(), _tape_a_renamed()
+    c1, c2 = canonical_query(t1), canonical_query(t2)
+    verdict, asn = solve_tape_ex(t1)
+    assert verdict == "sat"
+    doc = witness_to_doc(asn, c1)
+    # JSON round-trip: the doc must survive the store's serialization
+    doc = json.loads(json.dumps(doc))
+    asn2 = witness_from_doc(t2, c2, doc)
+    assert asn2 is not None and witness_ok(t2, asn2)
+    # the semantic coordinates came through verbatim
+    assert asn2.read_calldata_word(0) == asn.read_calldata_word(0) == 5
+
+
+# --- verdict store ------------------------------------------------------
+
+def test_vstore_corruption_is_a_counted_miss(tmp_path):
+    store = VerdictStore(str(tmp_path / "vs"))
+    store.put("ab" * 16, "unsat")
+    # a second store instance (no RAM cache) sees the corrupt file
+    cold = VerdictStore(str(tmp_path / "vs"))
+    p = cold._file("ab" * 16)
+    with open(p, "w") as fh:
+        fh.write('{"schema": 1, "key": "')   # torn mid-write
+    c = obs_metrics.REGISTRY.counter("solver_vstore_corrupt_total")
+    before = c.value
+    assert cold.get("ab" * 16) is None
+    assert c.value == before + 1
+    # the corrupt file was cleared so a re-decided verdict can land
+    assert not os.path.exists(p)
+    assert cold.put("ab" * 16, "unsat") is True
+    assert cold.get("ab" * 16)["verdict"] == "unsat"
+
+
+def test_vstore_concurrent_writers_first_wins(tmp_path):
+    store = VerdictStore(str(tmp_path / "vs"))
+    assert store.put("cd" * 16, "sat", {"vars": {"0": 1}}) is True
+    # a racing (later) writer of the same key loses and drops its copy
+    other = VerdictStore(str(tmp_path / "vs"))
+    assert other.put("cd" * 16, "sat", {"vars": {"0": 2}}) is False
+    assert other.get("cd" * 16)["witness"]["vars"]["0"] == 1
+
+
+def test_vstore_never_stores_unknown(tmp_path):
+    store = VerdictStore(str(tmp_path / "vs"))
+    with pytest.raises(ValueError):
+        store.put("ef" * 16, "unknown")
+    portfolio.set_store(store)
+    # MUL(leaf, 2) == 1 has no solution mod 2^256 but the refuter
+    # cannot prove it (even multiplier is not injective) — with a tiny
+    # budget the search exhausts to `unknown`, which must NOT land in
+    # the durable store (the LRU may keep it: its key carries the
+    # budget)
+    nodes = [
+        N(SymOp.NULL),
+        N(SymOp.FREE, int(FreeKind.CALLDATA_WORD), 0),   # 1
+        N(SymOp.CONST, imm=2),                           # 2
+        N(SymOp.MUL, 1, 2),                              # 3
+        N(SymOp.CONST, imm=1),                           # 4
+        N(SymOp.EQ, 3, 4),                               # 5
+    ]
+    t = HostTape(nodes=nodes, constraints=[(5, True)])
+    verdict, asn = solve_tape_ex(t, max_iters=5)
+    assert verdict == "unknown" and asn is None
+    assert store.count() == 0
+
+
+# --- the staged pipeline ------------------------------------------------
+
+def test_portfolio_store_hit_serves_verified_witness(tmp_path):
+    portfolio.set_store(str(tmp_path / "vs"))
+    p0 = portfolio.PORTFOLIO_STATS.snapshot()
+    t1 = _tape_a()
+    v1, a1 = solve_tape_ex(t1)         # cold: search decides + stores
+    assert v1 == "sat"
+    assert portfolio.get_store().count() == 1
+    _SOLVE_CACHE.clear()               # "a different process"
+    t2 = _tape_a_renamed()
+    v2, a2 = solve_tape_ex(t2)         # warm: the store resolves it
+    d = portfolio.stats_delta(portfolio.PORTFOLIO_STATS.snapshot(), p0)
+    assert v2 == "sat" and witness_ok(t2, a2)
+    assert d["stages"]["store"]["hits"] == 1
+    assert d["stages"]["search"]["attempts"] == 1  # only the cold query
+    assert d["witness_mismatch"] == 0
+    # same witness the search would have produced (determinism): the
+    # byte-identical-results contract at the query level
+    assert bytes(a2.calldata) == bytes(a1.calldata)
+
+
+def test_portfolio_prometheus_export_names():
+    # the serve daemon's /metrics renders REGISTRY.to_prometheus() —
+    # the ladder counters must be present under their stable names
+    portfolio.register_metrics()
+    solve_tape_ex(_tape_a())
+    text = obs_metrics.REGISTRY.to_prometheus()
+    for name in ("mythril_solver_queries_total",
+                 "mythril_solver_queries_stage_search_total",
+                 "mythril_solver_hits_stage_store_total",
+                 "mythril_solver_witness_mismatch_total"):
+        assert name in text, name
+
+
+def test_cli_flags_parse():
+    from mythril_tpu.interfaces.cli import create_parser
+
+    p = create_parser()
+    a = p.parse_args(["analyze", "--corpus", "x",
+                      "--solver-store", "/tmp/vs"])
+    assert a.solver_store == "/tmp/vs" and not a.no_solver_store
+    a = p.parse_args(["analyze", "--corpus", "x", "--no-solver-store"])
+    assert a.no_solver_store
+    s = p.parse_args(["serve", "--solver-store", "/tmp/vs"])
+    assert s.solver_store == "/tmp/vs"
+
+
+# --- campaign-level parity + the acceptance bar -------------------------
+
+# a require()-guarded selfdestruct: the path to SELFDESTRUCT carries a
+# real LT constraint, so the witness search actually runs (a bare
+# SELFDESTRUCT resolves at the probe stage and stores nothing)
+GUARDED = assemble(
+    4, "CALLDATALOAD", ("push2", 1000), "LT",       # 1000 < arg
+    ("ref", "ok"), "JUMPI", "STOP",
+    ("label", "ok"), 0, "SELFDESTRUCT")
+SAFE = assemble(1, 0, "SSTORE", "STOP")
+
+
+def _write_clone_corpus(tmp_path, n=8):
+    """Clone-heavy corpus (acceptance criterion: fixtures duplicated
+    >= 4x): 4 byte-identical guarded-killable clones + 4 safe clones."""
+    d = tmp_path / "corpus"
+    d.mkdir(exist_ok=True)
+    for i in range(n):
+        code = GUARDED if i % 2 == 0 else SAFE
+        (d / f"c{i:03d}.hex").write_text(code.hex())
+    return str(d)
+
+
+def _campaign(corpus, tmp_path, tag, store):
+    from mythril_tpu.mythril.campaign import (CorpusCampaign,
+                                              load_corpus_dir)
+
+    return CorpusCampaign(
+        load_corpus_dir(corpus),
+        batch_size=4, lanes_per_contract=8, limits=TEST_LIMITS,
+        max_steps=64, transaction_count=1,
+        modules=["AccidentallyKillable"],
+        checkpoint_dir=str(tmp_path / f"ck_{tag}"),
+        solver_store=store)
+
+
+def _issue_sig(res):
+    """EVERYTHING issue-visible, witnesses included — the
+    byte-identical bar, not just the issue count."""
+    return json.dumps(sorted(res.issues, key=lambda i: i["contract"]),
+                      sort_keys=True)
+
+
+def test_campaign_parity_and_warm_store_acceptance(tmp_path):
+    corpus = _write_clone_corpus(tmp_path)
+    store_dir = str(tmp_path / "solver_store")
+
+    _SOLVE_CACHE.clear()
+    off = _campaign(corpus, tmp_path, "off", None).run()
+    assert {i["contract"] for i in off.issues} == {"c000", "c002",
+                                                   "c004", "c006"}
+    sig_off = _issue_sig(off)
+
+    _SOLVE_CACHE.clear()
+    cold = _campaign(corpus, tmp_path, "cold", store_dir).run()
+    assert _issue_sig(cold) == sig_off          # store cold: identical
+    n_stored = VerdictStore(store_dir).count()
+    assert n_stored >= 1                        # search results landed
+    # the run-scoped store was restored afterwards
+    assert portfolio.get_store() is None
+
+    _SOLVE_CACHE.clear()                        # a fresh process's view
+    warm = _campaign(corpus, tmp_path, "warm", store_dir).run()
+    assert _issue_sig(warm) == sig_off          # store warm: identical
+
+    # acceptance: >= 50% of the warm run's SAT queries resolved BEFORE
+    # the search stage, visible in the per-stage counters
+    pf = warm.solver_portfolio
+    stages = pf["stages"]
+    sat_total = sum(stages[s]["sat"] for s in portfolio.STAGES)
+    assert sat_total >= 1
+    sat_before_search = sat_total - stages["search"]["sat"]
+    assert sat_before_search / sat_total >= 0.5, pf
+    assert stages["store"]["hits"] >= 1, pf
+    assert pf["z3_avoided_pct"] >= 50.0, pf
+
+
+def test_fleet_workers_share_solver_store(tmp_path):
+    """Worker 0 dies mid-fleet; its search verdicts are already durable
+    in <fleet-dir>/solver_store (the --fleet default), so worker 1 —
+    LRU-cold, as a fresh host would be — finishes the corpus with
+    store-stage hits instead of repeating the search."""
+    import time as _time
+
+    from mythril_tpu.resilience import FaultInjector, InjectedKill
+
+    corpus = _write_clone_corpus(tmp_path)
+    fleet = str(tmp_path / "fleet")
+
+    def worker(wid, fault=None):
+        from mythril_tpu.mythril.campaign import (CorpusCampaign,
+                                                  load_corpus_dir)
+
+        return CorpusCampaign(
+            load_corpus_dir(corpus),
+            batch_size=4, lanes_per_contract=8, limits=TEST_LIMITS,
+            max_steps=64, transaction_count=1,
+            modules=["AccidentallyKillable"],
+            fault_injector=FaultInjector.from_string(fault),
+            fleet_dir=fleet, lease_ttl=0.5, worker_id=wid)
+
+    _SOLVE_CACHE.clear()
+    with pytest.raises(InjectedKill):
+        # nth=2: w0 finishes whichever unit it claims FIRST (the claim
+        # scan starts at a worker-hash offset, so "batch=1" could land
+        # before anything committed) and dies on its second — its first
+        # unit's search verdicts are then durably in the shared store
+        worker("w0", fault="kill:nth=2").run()
+    store_dir = os.path.join(fleet, "solver_store")
+    pre_kill = VerdictStore(store_dir).count()
+    assert pre_kill >= 1                 # w0's unit-0 verdicts durable
+    assert portfolio.get_store() is None  # scope restored past the kill
+
+    _time.sleep(0.6)                     # w0's lease heartbeat expires
+    _SOLVE_CACHE.clear()                 # w1 is a different host
+    r1 = worker("w1").run()
+    assert [e for e in r1.backend_events
+            if e.get("kind") == "lease_reclaimed"]
+    stages = r1.solver_portfolio["stages"]
+    assert stages["store"]["hits"] >= 1, r1.solver_portfolio
+    # per-unit records carry their own portfolio deltas for the merge
+    assert all("solver_portfolio" in u for u in r1.fleet["units"])

@@ -48,7 +48,16 @@ Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
      and a restarted daemon given the same data dir must serve the
      completed contracts from the dedupe store (serve_dedupe_hits_total
      == 2, served_from == dedupe-store) and analyze only the rest —
-     every contract exactly once, the same issue set as a batch run.
+     every contract exactly once, the same issue set as a batch run;
+ 10. solver-store — the staged solver portfolio's durable verdict
+     store (docs/solver.md): kill a campaign mid-corpus with
+     --solver-store attached, restart on the same checkpoint + store
+     dirs to completion, then run a FULL second campaign over the warm
+     store with the in-process LRU cleared (a fresh process's view):
+     warm-store hits must be >= the verdicts committed before the
+     kill, and the final issue set must be byte-identical to a
+     store-disabled baseline — no verdict divergence, exactly-once
+     durability for solver work like for everything else.
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
@@ -106,7 +115,7 @@ SAFE = assemble(1, 0, "SSTORE", "STOP")
 N = 6  # even indices killable -> expected issues c000/c002/c004
 
 LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry",
-        "pipeline", "fleet", "serve")
+        "pipeline", "fleet", "serve", "solver_store")
 
 
 def write_corpus(d: str) -> str:
@@ -490,6 +499,72 @@ def main() -> int:
                    and dedupe_hits == 2
                    and from_store == ["c000", "c001"]
                    and issues == ["c000", "c002", "c004"])
+
+        if "solver_store" in want:
+            # leg 10: the solver-portfolio verdict store under a kill.
+            # The shared soak corpus is branchless (a bare SELFDESTRUCT
+            # resolves at the probe stage — nothing ever reaches the
+            # search, so nothing would be stored); this leg uses a
+            # clone-heavy GUARDED corpus whose selfdestruct hides
+            # behind a require-style bound, forcing a real witness
+            # search whose verdict the store must carry across the
+            # kill.
+            from mythril_tpu.smt.solver import _SOLVE_CACHE
+
+            guarded = assemble(
+                4, "CALLDATALOAD", ("push2", 1000), "LT",  # 1000 < arg
+                ("ref", "ok"), "JUMPI", "STOP",
+                ("label", "ok"), 0, "SELFDESTRUCT")
+            corpus10 = os.path.join(d, "corpus10")
+            os.makedirs(corpus10, exist_ok=True)
+            for i in range(N):
+                code = guarded if i % 2 == 0 else SAFE
+                with open(os.path.join(corpus10, f"g{i:03d}.hex"),
+                          "w") as fh:
+                    fh.write(code.hex())
+            store_dir = os.path.join(d, "solver_store")
+            ck10 = os.path.join(d, "ck10")
+            # store-disabled baseline: the no-divergence reference
+            _SOLVE_CACHE.clear()
+            base_r = campaign(corpus10, os.path.join(d, "ck10b"), None,
+                              solver_store=None).run()
+            base_issues = sorted(i["contract"] for i in base_r.issues)
+            _SOLVE_CACHE.clear()
+            killed = False
+            try:
+                campaign(corpus10, ck10, "kill:batch=1",
+                         solver_store=store_dir).run()
+            except InjectedKill:
+                killed = True
+            pre_kill = len([f for f in os.listdir(store_dir)
+                            if f.endswith(".json")]) \
+                if os.path.isdir(store_dir) else 0
+            # resume on the same dirs to completion (exactly-once)
+            r10a = campaign(corpus10, ck10, None,
+                            solver_store=store_dir).run()
+            # a "fresh process": only the durable store survives — the
+            # LRU (which would mask store hits) is cleared
+            _SOLVE_CACHE.clear()
+            r10 = campaign(corpus10, os.path.join(d, "ck10w"), None,
+                           solver_store=store_dir).run()
+            stages = (r10.solver_portfolio or {}).get("stages") or {}
+            store_hits = (stages.get("store") or {}).get("hits", 0)
+            issues = sorted(i["contract"] for i in r10.issues)
+            legs["solver_store"] = {
+                "killed": killed,
+                "pre_kill_verdicts": pre_kill,
+                "resumed_batches": r10a.batches,
+                "warm_store_hits": store_hits,
+                "z3_avoided_pct": (r10.solver_portfolio or {}).get(
+                    "z3_avoided_pct"),
+                "issues": issues,
+            }
+            ok &= (killed and r10a.batches == 2
+                   and pre_kill >= 1
+                   and store_hits >= pre_kill
+                   and issues == base_issues
+                   and sorted(i["contract"] for i in r10a.issues)
+                   == base_issues)
 
     print(json.dumps({"ok": bool(ok), "legs": legs}))
     return 0 if ok else 1

@@ -12,7 +12,12 @@ Reads either output of the span tracer — the Chrome-trace JSON
      by direction, and how much host-phase time the pipelined campaign
      hid behind device execution — docs/performance.md),
   6. a fleet summary (unit leases claimed/committed/reclaimed/lost and
-     the reclaim/lost timeline — docs/fleet.md).
+     the reclaim/lost timeline — docs/fleet.md),
+  7. solver totals (attempts / sat / unsat / unknown and the unknown
+     rate — the silent-false-negative channel, docs/solver.md),
+  8. a solver portfolio ladder (per-stage attempts / hits / hit rate /
+     time across lru -> refute -> probe -> store -> search, plus the
+     Z3-avoided headline — docs/solver.md).
 
 Usage:
     python tools/trace_report.py t.json [--top N]
@@ -259,6 +264,51 @@ def report(spans: List[Dict], instants: List[Dict], top: int = 10) -> str:
                         f"{a.get('unit', '?')} dropped")
     else:
         out.append("(no fleet events — static single/multi-host run?)")
+
+    # 7 + 8. solver totals and the portfolio ladder: the campaign emits
+    # one CUMULATIVE `solver_portfolio` event per batch commit, so the
+    # LAST one is the run's final state — no summing needed here
+    pf = [e for e in instants if e["kind"] == "solver_portfolio"]
+    last = pf[-1]["args"] if pf else {}
+    out.append("")
+    out.append("== solver totals ==")
+    attempts = int(last.get("attempts", 0) or 0)
+    if attempts:
+        unk = int(last.get("unknown", 0) or 0)
+        out.append(f"attempts: {attempts}  sat: {last.get('sat', 0)}  "
+                   f"unsat: {last.get('unsat', 0)}  unknown: {unk}")
+        out.append(f"unknown rate: {100.0 * unk / attempts:.1f}% "
+                   "(queries that silently dropped a candidate finding)")
+    else:
+        out.append("(no solver_portfolio events — pre-portfolio trace "
+                   "or no solver queries)")
+
+    out.append("")
+    out.append("== solver portfolio ==")
+    stages = last.get("stages") or {}
+    if stages:
+        q = int(last.get("queries", 0) or 0)
+        out.append(f"queries: {q}  Z3-avoided: "
+                   f"{float(last.get('z3_avoided_pct', 0.0)):.1f}% "
+                   "(resolved before the witness search)")
+        out.append(f"{'stage':<10}{'attempts':>10}{'hits':>8}"
+                   f"{'hit%':>7}{'sat':>7}{'unsat':>7}{'time':>10}")
+        for s in ("lru", "refute", "probe", "store", "search"):
+            st = stages.get(s) or {}
+            a = int(st.get("attempts", 0) or 0)
+            h = int(st.get("hits", 0) or 0)
+            rate = f"{100.0 * h / a:.0f}%" if a else "-"
+            out.append(
+                f"{s:<10}{a:>10}{h:>8}{rate:>7}"
+                f"{int(st.get('sat', 0) or 0):>7}"
+                f"{int(st.get('unsat', 0) or 0):>7}"
+                f"{_fmt_s(float(st.get('time_sec', 0.0) or 0.0)):>10}")
+        mm = int(last.get("witness_mismatch", 0) or 0)
+        if mm:
+            out.append(f"witness re-verification misses: {mm} "
+                       "(served entries that fell through)")
+    else:
+        out.append("(no per-stage data — pre-portfolio trace?)")
     return "\n".join(out)
 
 
