@@ -1,0 +1,303 @@
+"""Supervised engine worker: subprocess-isolated device execution.
+
+The child half of the process-isolation boundary
+(docs/resilience.md "Process isolation & supervision"): this process
+OWNS the JAX backend and runs device batches on behalf of a parent
+``WorkerSupervisor`` (mythril_tpu/resilience.py), speaking a
+length-prefixed pickle protocol over its stdin/stdout pipes. The
+division of labor:
+
+- a libtpu segfault, an OOM kill, or a wedged XLA compile happens
+  HERE — the parent observes pipe EOF (death) or a missed deadline
+  (hang) and restarts this process, feeding the failed batch back
+  through the campaign's retry→ladder→bisect machinery;
+- an engine EXCEPTION (solver error, RESOURCE_EXHAUSTED, a poison
+  contract) is caught, classified with
+  :func:`mythril_tpu.resilience.classify_backend_error`, and returned
+  as an error reply — the worker survives, and the parent rehydrates
+  the same typed error its in-process path would have seen.
+
+Protocol (every frame = 8-byte big-endian length + pickle):
+
+- ``{"op": "init", "stub": bool, "config": {...}}`` → builds the
+  resident engine (or nothing, in stub mode) and replies
+  ``{"ok": True, "value": {"pid": ...}}``. ``config`` carries the
+  parent campaign's engine knobs (shapes, limits, spec, solver
+  budget); the worker builds its own corpus-less ``CorpusCampaign``
+  and serves batches through its ``_explore_batch``/``_harvest_batch``
+  seam, so batch semantics (padding, warm shapes, pad filtering) are
+  the campaign's own code, not a re-implementation.
+- ``{"op": "batch", "bi", "names", "codes", "lanes", "width",
+  "on_cpu"}`` → ``{"ok": True, "value": {issues/paths/dropped/iprof}}``
+  or ``{"ok": False, "etype", "emsg", "classify"}``.
+- ``{"op": "ping"}`` → rss diagnostics; ``{"op": "exit"}`` → clean 0.
+
+Stdout is the protocol channel: the REAL fd is duplicated away at
+startup and fd 1 is re-pointed at stderr, so engine prints and jax
+warnings can never corrupt a frame. EOF on stdin (parent death) exits
+the worker — an orphaned worker never outlives its supervisor.
+
+Deterministic chaos (tools/chaos_campaign.py): the
+``MYTHRIL_WORKER_FAULT`` env var — ``sig:point:nth[:once=PATH]`` with
+``sig`` ∈ kill|segv and ``point`` ∈ mid-compile|mid-superstep|
+mid-reply — makes the worker deliver a REAL signal to itself at the
+named point of its ``nth`` batch request (``once=PATH`` is a cookie
+file so the fault fires exactly once across restarts). ``mid-reply``
+writes a torn half-frame first, so the parent also exercises the
+truncated-IPC path.
+
+Stub mode (``init`` with ``stub=True``) skips every engine import and
+answers batches with deterministic counts — the fast worker for
+supervision-machinery tests; pipes, signals and process death are just
+as real. A stub batch whose names include ``__hang__`` sleeps forever
+(the parent-deadline fixture).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+import signal
+import struct
+import sys
+import time
+from typing import BinaryIO, Dict, Optional
+
+#: frame header: one 8-byte big-endian payload length
+FRAME_HEADER = struct.Struct(">Q")
+
+PROTOCOL_VERSION = 1
+
+_FAULT_SIGNALS = {"kill": signal.SIGKILL, "segv": signal.SIGSEGV}
+_FAULT_POINTS = ("mid-compile", "mid-superstep", "mid-reply")
+
+
+def pack_frame(obj) -> bytes:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return FRAME_HEADER.pack(len(data)) + data
+
+
+def read_frame(stream: BinaryIO):
+    """One frame from a blocking stream, or None on EOF (the child's
+    read side; the parent reads with a deadline instead — see
+    ``WorkerSupervisor._read_frame``)."""
+    hdr = b""
+    while len(hdr) < FRAME_HEADER.size:
+        chunk = stream.read(FRAME_HEADER.size - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = FRAME_HEADER.unpack(hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = stream.read(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class ChildFault:
+    """Parsed ``MYTHRIL_WORKER_FAULT`` spec (see module docstring)."""
+
+    def __init__(self, sig: int, point: str, nth: int,
+                 once: Optional[str] = None):
+        self.sig = sig
+        self.point = point
+        self.nth = nth
+        self.once = once
+
+    @classmethod
+    def from_env(cls) -> Optional["ChildFault"]:
+        text = os.environ.get("MYTHRIL_WORKER_FAULT")
+        if not text:
+            return None
+        parts = text.strip().split(":")
+        if len(parts) < 3 or parts[0] not in _FAULT_SIGNALS \
+                or parts[1] not in _FAULT_POINTS:
+            raise ValueError(
+                f"MYTHRIL_WORKER_FAULT {text!r}: expected "
+                f"sig:point:nth[:once=PATH] with sig of "
+                f"{tuple(_FAULT_SIGNALS)} and point of {_FAULT_POINTS}")
+        once = None
+        for extra in parts[3:]:
+            if extra.startswith("once="):
+                once = extra[len("once="):]
+            else:
+                raise ValueError(
+                    f"MYTHRIL_WORKER_FAULT {text!r}: unknown option "
+                    f"{extra!r}")
+        return cls(_FAULT_SIGNALS[parts[0]], parts[1], int(parts[2]),
+                   once)
+
+    def _take(self) -> bool:
+        """Claim the fault. With ``once=PATH`` the cookie file is the
+        cross-restart memory: the first taker creates it and fires,
+        every later (restarted) worker sees it and stays healthy."""
+        if self.once is None:
+            return True
+        try:
+            fd = os.open(self.once, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except OSError:
+            return True  # unwritable cookie dir: still fire (visible)
+        os.close(fd)
+        return True
+
+    def should(self, point: str, nth: int) -> bool:
+        return (point == self.point and nth == self.nth
+                and self._take())
+
+    def fire(self, point: str, nth: int) -> None:
+        """Deliver the REAL signal to this process at a named point —
+        a genuine SIGSEGV/SIGKILL death, not a Python exception."""
+        if self.should(point, nth):
+            os.kill(os.getpid(), self.sig)
+            time.sleep(5)  # SIGKILL delivery is async; don't race on
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError, AttributeError):
+        return 0
+
+
+def _build_campaign(config: Dict):
+    """The worker's resident engine: a corpus-less CorpusCampaign with
+    the parent's knobs. Heavy imports happen here, under the parent's
+    spawn deadline — a wedged backend init is a killed worker, not a
+    wedged fleet."""
+    import mythril_tpu  # noqa: F401  (enables x64)
+
+    cache = os.environ.get("MYTHRIL_WORKER_JAX_CACHE")
+    if cache:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    if config.get("solver_store"):
+        from .smt import portfolio as smt_portfolio
+
+        smt_portfolio.set_store(config["solver_store"])
+    from .mythril.campaign import CorpusCampaign
+
+    return CorpusCampaign(
+        [],
+        batch_size=int(config.get("batch_size", 32)),
+        lanes_per_contract=int(config.get("lanes_per_contract", 32)),
+        limits=config["limits"],
+        spec=config.get("spec"),
+        max_steps=int(config.get("max_steps", 256)),
+        transaction_count=int(config.get("transaction_count", 1)),
+        modules=config.get("modules"),
+        solver_timeout=config.get("solver_timeout"),
+        solver_iters=int(config.get("solver_iters", 400)),
+        parallel_solving=bool(config.get("parallel_solving", False)),
+        solver_workers=int(config.get("solver_workers", 1)),
+        enable_iprof=bool(config.get("enable_iprof", False)),
+        batch_timeout=None,         # the PARENT enforces the deadline
+        worker_isolation="off",     # no recursive workers
+        solver_store=None,          # installed above, process-global
+    )
+
+
+def _run_batch(camp, stub: bool, msg: Dict,
+               fault: Optional[ChildFault], nth: int) -> Dict:
+    bi = int(msg["bi"])
+    names = list(msg["names"])
+    codes = list(msg["codes"])
+    lanes = msg.get("lanes")
+    width = msg.get("width")
+    if fault is not None:
+        fault.fire("mid-compile", nth)
+    if stub:
+        if "__hang__" in names:
+            time.sleep(3600)
+        if fault is not None:
+            fault.fire("mid-superstep", nth)
+        return {"issues": [], "paths": len(names), "dropped": 0,
+                "iprof": {}}
+    cm = camp._cpu_device() if msg.get("on_cpu") else None
+    with (cm if cm is not None else contextlib.nullcontext()):
+        sym = camp._explore_batch(bi, names, codes, lanes, width)
+        if fault is not None:
+            # after the device work ran, before the host harvest: the
+            # closest honest stand-in for "mid-superstep" a process
+            # boundary allows
+            fault.fire("mid-superstep", nth)
+        return camp._harvest_batch(bi, sym)
+
+
+def worker_main() -> int:
+    # claim the protocol channel, then point fd 1 at stderr so engine
+    # prints / jax warnings cannot corrupt a frame
+    inp = os.fdopen(os.dup(sys.stdin.fileno()), "rb", buffering=0)
+    out = os.fdopen(os.dup(sys.stdout.fileno()), "wb", buffering=0)
+    os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
+    sys.stdout = sys.stderr
+    fault = ChildFault.from_env()
+    camp = None
+    stub = False
+    nbatch = 0
+    while True:
+        msg = read_frame(inp)
+        if msg is None:
+            return 0  # parent closed the pipe (or died): exit with it
+        op = msg.get("op")
+        tear = False
+        try:
+            if op == "init":
+                stub = bool(msg.get("stub"))
+                if not stub:
+                    camp = _build_campaign(msg.get("config") or {})
+                reply = {"ok": True,
+                         "value": {"pid": os.getpid(), "stub": stub,
+                                   "protocol": PROTOCOL_VERSION}}
+            elif op == "ping":
+                reply = {"ok": True, "value": {"pid": os.getpid(),
+                                               "rss": _rss_bytes()}}
+            elif op == "batch":
+                nbatch += 1
+                reply = {"ok": True,
+                         "value": _run_batch(camp, stub, msg, fault,
+                                             nbatch)}
+                tear = (fault is not None
+                        and fault.should("mid-reply", nbatch))
+            elif op == "exit":
+                try:
+                    out.write(pack_frame({"ok": True, "value": None}))
+                    out.flush()
+                except OSError:
+                    pass
+                return 0
+            else:
+                reply = {"ok": False, "etype": "ValueError",
+                         "emsg": f"unknown op {op!r}", "classify": None}
+        except BaseException as e:  # noqa: BLE001 — relayed typed
+            from .resilience import classify_backend_error
+
+            reply = {"ok": False, "etype": type(e).__name__,
+                     "emsg": str(e)[:2000],
+                     "classify": classify_backend_error(e)}
+        frame = pack_frame(reply)
+        try:
+            if tear:
+                # torn mid-reply: half a frame on the wire, then a real
+                # signal — the parent must treat it as worker death
+                out.write(frame[:max(1, len(frame) // 2)])
+                out.flush()
+                os.kill(os.getpid(), fault.sig)
+                time.sleep(5)
+            out.write(frame)
+            out.flush()
+        except OSError:
+            return 0  # parent went away mid-reply
+
+
+if __name__ == "__main__":
+    raise SystemExit(worker_main())

@@ -134,6 +134,9 @@ class WorkLedger:
         self.mode = "static"
         self.unit_names_list: List[List[str]] = []
         self.closed = False
+        # result files that already parsed once: claim sweeps re-check
+        # only unverified units, so torn-result detection stays O(new)
+        self._verified_results: set = set()
 
     # --- events / metrics ----------------------------------------------
     def _event(self, kind: str, **kw) -> None:
@@ -388,6 +391,40 @@ class WorkLedger:
         return (os.path.exists(self._lost_path(uid))
                 and not os.path.exists(self._result_path(uid)))
 
+    def _result_committed(self, uid: str) -> bool:
+        """Whether the unit has a LOADABLE committed result. A torn or
+        corrupt result file (external truncation, a misbehaving shared
+        filesystem — ``exclusive_write`` itself is atomic) used to
+        block the unit forever: no worker could re-claim it (the file
+        existed) and no merge could read it (it didn't parse) — the
+        chaos matrix's ``torn-ledger`` row. Now the corrupt file is
+        set ASIDE (``.corrupt`` — evidence preserved, name freed) with
+        a ``unit_result_corrupt`` event, and the unit becomes
+        claimable again; the re-run's commit wins the freed name."""
+        p = self._result_path(uid)
+        if uid in self._verified_results:
+            return True
+        try:
+            with open(p) as fh:
+                json.load(fh)
+        except FileNotFoundError:
+            return False
+        except (OSError, ValueError) as e:
+            try:
+                os.replace(p, p + ".corrupt")
+            except OSError:
+                return True  # can't free the name: leave it to merge
+            obs_metrics.REGISTRY.counter(
+                "fleet_result_corrupt_total",
+                help="torn/corrupt unit result files set aside for "
+                     "re-analysis").inc()
+            self._event("unit_result_corrupt", unit=uid,
+                        detail=f"{p}: {e}"[:300]
+                               + "; set aside, unit re-claimable")
+            return False
+        self._verified_results.add(uid)
+        return True
+
     # --- claim / reclaim -------------------------------------------------
     def _scan_order(self) -> range:
         return range(self.n_units)
@@ -476,7 +513,7 @@ class WorkLedger:
         for j in self._scan_order():
             k = (j + off) % self.n_units
             uid = self.uid(k)
-            if (os.path.exists(self._result_path(uid))
+            if (self._result_committed(uid)
                     or os.path.exists(self._lost_path(uid))):
                 continue
             lease = self._lease_path(uid)
@@ -509,20 +546,33 @@ class WorkLedger:
         by other workers — they become claimable when the TTL lapses)."""
         for k in self._scan_order():
             uid = self.uid(k)
-            if not (os.path.exists(self._result_path(uid))
+            if not (self._result_committed(uid)
                     or os.path.exists(self._lost_path(uid))):
                 return True
         return False
 
     # --- heartbeat -------------------------------------------------------
     def renew(self, unit: WorkUnit) -> None:
-        """Stamp the lease heartbeat (mtime). Best-effort: a missing
-        file means the unit was committed (by us) or reclaimed (we were
-        presumed dead) — either way commit-time arbitration decides, so
-        the renew just stops."""
+        """Stamp the lease heartbeat (mtime). A failed ``utime`` is NOT
+        silent (it used to be — the unit would quietly drift toward
+        reclaim while its worker believed it was heartbeating): every
+        failure lands as a ``lease_renew_failed`` event +
+        ``fleet_renew_failures_total`` tick, and the renewer RETRIES on
+        its next tick — a transient NFS error must not end
+        heartbeating for good. A missing lease file (we committed, or
+        were presumed dead and reclaimed-from) is reported the same
+        way; commit-time arbitration still decides who wins."""
         try:
             os.utime(self._lease_path(unit.uid))
-        except OSError:
+        except OSError as e:
+            obs_metrics.REGISTRY.counter(
+                "fleet_renew_failures_total",
+                help="lease heartbeat renewals that failed (missing "
+                     "lease file or I/O error); retried next tick").inc()
+            self._event(
+                "lease_renew_failed", unit=unit.uid,
+                detail=(f"{type(e).__name__}: {e}"[:200]
+                        + "; retrying next tick"))
             return
         obs_trace.event("lease_renew", unit=unit.uid, worker=self.worker)
 

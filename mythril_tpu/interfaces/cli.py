@@ -234,6 +234,17 @@ def create_parser() -> argparse.ArgumentParser:
                    help="disable the solver verdict store (including "
                         "the --fleet default); the in-process LRU and "
                         "the refute/probe stages stay on")
+    a.add_argument("--worker-isolation", choices=["on", "off", "auto"],
+                   default="auto",
+                   help="campaign mode: run device batches in a "
+                        "supervised engine-worker SUBPROCESS so a "
+                        "libtpu segfault / OOM kill / hard hang is a "
+                        "worker restart (replayed through "
+                        "retry/ladder/bisect), never process death; "
+                        "N rapid deaths open a crash-loop breaker "
+                        "that pins work to the in-process CPU path "
+                        "(docs/resilience.md). auto (default) = on "
+                        "under --fleet, off otherwise")
     a.add_argument("--fleet-follow", action="store_true",
                    help="fleet mode: join a serve daemon's FEED ledger "
                         "(docs/serving.md) — units carry their own "
@@ -397,6 +408,15 @@ def create_parser() -> argparse.ArgumentParser:
                          "batches (batch indices count monotonically "
                          "over the daemon lifetime)")
     sv.add_argument("--concrete-storage", action="store_true")
+    sv.add_argument("--worker-isolation",
+                    choices=["on", "off", "auto"], default="auto",
+                    help="run service batches in a supervised "
+                         "engine-worker subprocess (auto = ON under "
+                         "serve): backend death becomes a worker "
+                         "restart, a crash loop opens a breaker that "
+                         "pins the config to in-process CPU — "
+                         "reported in /healthz degraded_configs "
+                         "(docs/resilience.md)")
     sv.add_argument("--trace", metavar="FILE",
                     help="Chrome-trace + JSONL event log (admit/"
                          "queue_wait/schedule/stream spans ride the "
@@ -818,6 +838,7 @@ def _exec_campaign(args) -> int:
         # (<fleet-dir>/solver_store); --no-solver-store beats both
         solver_store=(None if args.no_solver_store
                       else (args.solver_store or "auto")),
+        worker_isolation=args.worker_isolation,
     )
 
     unit_word = "unit" if args.fleet else "batch"
@@ -867,6 +888,7 @@ def exec_serve(args) -> int:
         oom_ladder=oom_ladder,
         fault_inject=args.fault_inject,
         concrete_storage=args.concrete_storage,
+        worker_isolation=args.worker_isolation,
     )
     daemon = AnalysisDaemon(
         opts, data_dir=args.data_dir, host=args.host, port=args.port,

@@ -61,8 +61,8 @@ from ..fleet import corpus_fingerprint
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..resilience import (BackendManager, BatchTimeout, DeviceLostError,
-                          FaultInjector, classify_backend_error,
-                          run_with_watchdog)
+                          FaultInjector, WorkerCrashLoop,
+                          classify_backend_error, run_with_watchdog)
 from ..utils.checkpoint import (BackgroundCheckpointWriter,
                                 load_json_checkpoint_resilient,
                                 save_json_checkpoint)
@@ -78,6 +78,12 @@ log = logging.getLogger(__name__)
 #: pad contract for short batches: plain STOP (no paths beyond the seed,
 #: no issues, negligible lane cost)
 _PAD_BYTECODE = b"\x00"
+
+#: warm-shape marker for worker-isolated batches: the ENGINE WORKER's
+#: process-wide XLA cache is warm for the shape class, not this
+#: process's — the token is discarded when the worker dies (a fresh
+#: worker recompiles), keeping serve's warm-compile accounting honest
+_WORKER_WARM = ("worker-resident",)
 
 
 def load_corpus_dir(path: str) -> List[tuple]:
@@ -210,6 +216,8 @@ class CorpusCampaign:
         worker_id: Optional[str] = None,
         fleet_follow: bool = False,
         solver_store: Optional[str] = "auto",
+        worker_isolation: str = "off",
+        worker_supervisor=None,
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -355,6 +363,37 @@ class CorpusCampaign:
         # portfolio-stats baseline for this run's deltas (heartbeat
         # Z3-avoided %, per-batch solver_portfolio events, the report)
         self._pstats0: Optional[Dict] = None
+        # supervised engine worker (docs/resilience.md "Process
+        # isolation & supervision"): with isolation on, device batches
+        # run in a restartable SUBPROCESS that owns the JAX backend —
+        # libtpu segfaults / OOM kills / hard hangs become worker
+        # deaths the retry→ladder→bisect machinery replays, never
+        # parent death. "auto" = on under a fleet ledger (a dead
+        # worker there also wedges lease turnover); serve resolves its
+        # own auto in the campaign factory. Plugins and sharded specs
+        # can't cross the pickle boundary — isolation quietly stays
+        # off for them.
+        if isinstance(worker_isolation, bool):
+            isolate = worker_isolation
+        elif worker_isolation == "auto":
+            isolate = fleet_dir is not None or fleet_follow
+        elif worker_isolation in ("on", "off"):
+            isolate = worker_isolation == "on"
+        else:
+            raise ValueError(
+                f"worker_isolation {worker_isolation!r}: must be "
+                "'on', 'off' or 'auto'")
+        if isolate and (self.plugins
+                        or getattr(self.spec, "mesh", None) is not None):
+            log.warning("worker isolation disabled: plugins / sharded "
+                        "specs cannot cross the worker process "
+                        "boundary")
+            isolate = False
+        self.worker_isolation = isolate
+        self._supervisor = worker_supervisor
+        if worker_supervisor is not None \
+                and worker_supervisor.on_event is None:
+            worker_supervisor.on_event = self._worker_event
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -599,6 +638,84 @@ class CorpusCampaign:
         return self._harvest_batch(
             bi, self._explore_batch(bi, names, codes, lanes, width))
 
+    # --- supervised engine worker (docs/resilience.md) ------------------
+    def _worker_enabled(self) -> bool:
+        """Whether this batch goes through the engine-worker boundary:
+        isolation on AND the real engine is the runner (a stub
+        ``batch_runner`` has nothing to isolate — it runs in-process,
+        so fault-machinery tests keep their exact semantics)."""
+        return self.worker_isolation and self._batch_runner is None
+
+    def _worker_event(self, kind: str, detail: str = "", **kw) -> None:
+        """Supervisor events routed onto the campaign's event stream
+        (report ``backend_events`` + trace bus + counters). A worker
+        death also drops the worker-resident warm-shape markers: the
+        replacement process recompiles, and serve's warm-compile
+        accounting must say so."""
+        if kind == "worker_death":
+            for s in self._warm_shapes.values():
+                s.discard(_WORKER_WARM)
+        self._event(kind, detail=detail, **kw)
+
+    def _worker_config(self) -> Dict:
+        """The engine knobs the worker needs to mirror this campaign
+        (pickled across the spawn; see engine_worker._build_campaign)."""
+        return {
+            "batch_size": self.batch_size,
+            "lanes_per_contract": self.lanes_per_contract,
+            "limits": self.limits,
+            "spec": self.spec,
+            "max_steps": self.max_steps,
+            "transaction_count": self.transaction_count,
+            "modules": self.modules,
+            "solver_timeout": self.solver_timeout,
+            "solver_iters": self.solver_iters,
+            "parallel_solving": self.parallel_solving,
+            "solver_workers": self.solver_workers,
+            "enable_iprof": self.enable_iprof,
+            "solver_store": self.solver_store,
+        }
+
+    def _ensure_supervisor(self):
+        if self._supervisor is None:
+            from ..resilience import WorkerSupervisor
+
+            self._supervisor = WorkerSupervisor(
+                config=self._worker_config(),
+                batch_timeout=self.batch_timeout,
+                fault_injector=self.fault_injector,
+                on_event=self._worker_event)
+        return self._supervisor
+
+    def _worker_run(self, bi: int, names: List[str], codes: List[bytes],
+                    lanes: Optional[int], width: Optional[int],
+                    on_cpu: bool) -> Dict:
+        """One batch through the supervisor (which enforces the
+        per-batch deadline parent-side — no extra watchdog thread).
+        Success marks the shape class worker-warm."""
+        sup = self._ensure_supervisor()
+        out = sup.run_batch(bi, names, codes, lanes=lanes, width=width,
+                            on_cpu=on_cpu)
+        self._warm_set(lanes, width).add(_WORKER_WARM)
+        return out
+
+    def worker_status(self) -> Optional[Dict]:
+        """Supervisor diagnostics (breaker state, restarts, rss) for
+        ``serve`` ``/healthz`` and the heartbeat line; None when no
+        worker has been needed yet."""
+        if self._supervisor is None:
+            return None
+        return self._supervisor.status()
+
+    def close_worker(self) -> None:
+        """Shut the engine worker down (run() exit, serve drain). The
+        supervisor object is dropped, so a later batch respawns."""
+        if self._supervisor is not None:
+            try:
+                self._supervisor.close()
+            finally:
+                self._supervisor = None
+
     # --- resident mode (docs/serving.md) --------------------------------
     def run_external_batch(self, items: Sequence[tuple],
                            bi: Optional[int] = None) -> Dict:
@@ -664,9 +781,37 @@ class CorpusCampaign:
         """One attempt: fault-injection check + engine pass, under the
         wall-clock watchdog. A hung compile / wedged device call
         surfaces as BatchTimeout here instead of stalling the run.
-        ``lanes``/``width``/``on_cpu`` carry the degradation rung."""
+        ``lanes``/``width``/``on_cpu`` carry the degradation rung.
+
+        With worker isolation on, the pass runs in the supervised
+        engine-worker subprocess instead: the supervisor enforces the
+        same ``batch_timeout`` from the parent side (so no watchdog
+        thread is layered on top), a worker death raises
+        ``WorkerDied`` into the same retry→ladder→bisect tail, and an
+        open crash-loop breaker pins the attempt to the in-process CPU
+        path — the one backend the accelerator crash loop cannot
+        reach."""
         names = [n for n, _ in items]
         codes = [c for _, c in items]
+
+        injected = False
+        if self._worker_enabled():
+            if self.fault_injector is not None:
+                # parent-side injected faults (hang/raise/kill/oom)
+                # keep their exact semantics: fired under the watchdog
+                # like a serial attempt, BEFORE the worker dispatch
+                run_with_watchdog(
+                    lambda: self.fault_injector.fire(batch=bi,
+                                                     contracts=names),
+                    self.batch_timeout, label=f"batch {bi} inject")
+                injected = True
+            try:
+                return self._worker_run(bi, names, codes, lanes, width,
+                                        on_cpu)
+            except WorkerCrashLoop as e:
+                self._event("worker_breaker_pinned", batch=bi,
+                            detail=str(e)[:200])
+                on_cpu = True  # fall through to the in-process path
 
         def call_runner():
             runner = self._batch_runner or self._exec_batch
@@ -675,7 +820,7 @@ class CorpusCampaign:
             return runner(bi, names, codes, lanes=lanes, width=width)
 
         def work():
-            if self.fault_injector is not None:
+            if self.fault_injector is not None and not injected:
                 self.fault_injector.fire(batch=bi, contracts=names)
             if on_cpu:
                 cm = self._cpu_device()
@@ -696,7 +841,13 @@ class CorpusCampaign:
         A custom ``batch_runner`` has no device/host seam — the runner
         IS the whole attempt, so its finished result rides the handle
         and the host phase degenerates to a pass-through (same code
-        path, no overlap)."""
+        path, no overlap). The same holds for a worker-isolated batch:
+        the SymExecWrapper cannot cross the process boundary, so the
+        whole attempt runs in the worker (supervisor deadline, breaker
+        fallback — all of :meth:`_guarded_batch`'s worker semantics)
+        and the host phase passes the finished result through."""
+        if self._worker_enabled():
+            return ("out", self._guarded_batch(bi, items))
         names = [n for n, _ in items]
         codes = [c for _, c in items]
 
@@ -943,10 +1094,19 @@ class CorpusCampaign:
         age = (time.monotonic() - self._last_ckpt_mono
                if self._last_ckpt_mono is not None else None)
         age_s = f"{age:.1f}s" if age is not None else "never"
+        # engine-worker token (docs/resilience.md): restarts so far,
+        # plus the breaker state when it isn't closed — the operator's
+        # one-glance "is the backend crash-looping" signal
+        wst = self.worker_status()
+        wk = ""
+        if wst is not None:
+            wk = f" wkr r{wst['restarts']}"
+            if wst["breaker"] != "closed":
+                wk += f"/breaker-{wst['breaker']}"
         print(f"heartbeat: batch {done}/{total} contracts {contracts}/"
               f"{len(self.contracts)} paths/s {pps:.1f} frontier "
               f"{100.0 * occ:.0f}% rung {rung} z3-avoid {z3av:.0f}% "
-              f"ckpt-age {age_s}",
+              f"ckpt-age {age_s}{wk}",
               file=sys.stderr, flush=True)
         obs_trace.event("heartbeat", batch=done, batches_total=total,
                         contracts=contracts,
@@ -954,7 +1114,11 @@ class CorpusCampaign:
                         occupancy=round(occ, 4), rung=rung,
                         z3_avoided_pct=z3av,
                         ckpt_age=(round(age, 3) if age is not None
-                                  else None))
+                                  else None),
+                        worker_restarts=(wst["restarts"]
+                                         if wst is not None else None),
+                        worker_breaker=(wst["breaker"]
+                                        if wst is not None else None))
 
     # --- the pipelined loop --------------------------------------------
     def _run_pipelined(self, start_batch: int, n_batches: int,
@@ -1334,6 +1498,10 @@ class CorpusCampaign:
         finally:
             if self.solver_store:
                 smt_portfolio.set_store(prev_store)
+            # the engine worker must not outlive the run (a real
+            # SIGKILL of this process closes the pipes instead, and
+            # the worker exits on stdin EOF)
+            self.close_worker()
         res.solver_portfolio = smt_portfolio.stats_delta(
             smt_portfolio.PORTFOLIO_STATS.snapshot(), self._pstats0)
         return res

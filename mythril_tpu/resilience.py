@@ -36,7 +36,10 @@ process only.
 
 from __future__ import annotations
 
+import collections
 import os
+import select
+import signal
 import subprocess
 import sys
 import threading
@@ -61,6 +64,27 @@ class ResourceExhausted(ResilienceError):
     """Device/host memory exhaustion (injected or classified from a
     backend error). The campaign answers this with the degradation
     ladder — shrink the work, don't abort the run."""
+
+
+class WorkerDied(ResilienceError):
+    """The supervised engine worker subprocess died (segfault, OOM
+    kill, torn IPC reply, init failure). The batch it was running is
+    NOT lost — the supervisor restarts the worker and the campaign's
+    retry→ladder→bisect machinery replays the batch."""
+
+
+class WorkerError(ResilienceError):
+    """An exception raised INSIDE the engine worker, rehydrated on the
+    parent side. The message carries the original type name + text so
+    :func:`classify_backend_error`'s string triage still applies."""
+
+
+class WorkerCrashLoop(ResilienceError):
+    """The crash-loop circuit breaker is open: N worker deaths within
+    the window. The supervisor refuses to spawn until the cooldown
+    lapses; the campaign answers by pinning the batch to the in-process
+    CPU path (the trusted fallback the accelerator crash loop cannot
+    reach)."""
 
 
 class InjectedKill(BaseException):
@@ -183,7 +207,14 @@ def parse_ladder(text: Optional[str]) -> Tuple[str, ...]:
 
 # --- fault injection --------------------------------------------------
 
-FAULT_MODES = ("hang", "raise", "device-lost", "kill", "oom")
+FAULT_MODES = ("hang", "raise", "device-lost", "kill", "oom",
+               "worker-kill", "worker-segv")
+
+#: fault modes handled by the WorkerSupervisor (a signal is delivered
+#: to the engine worker SUBPROCESS) rather than raised in-process by
+#: :meth:`FaultInjector.fire`
+_WORKER_FAULT_SIGNALS = {"worker-kill": signal.SIGKILL,
+                         "worker-segv": signal.SIGSEGV}
 
 #: how long an injected hang sleeps per check; the watchdog is expected
 #: to fire long before the total (a daemon thread naps harmlessly after)
@@ -282,8 +313,12 @@ class FaultInjector:
     def fire(self, batch: Optional[int] = None,
              contracts: Sequence[str] = ()) -> None:
         """Raise/hang per the first matching spec (called INSIDE the
-        watchdog, so a hang surfaces as :class:`BatchTimeout`)."""
+        watchdog, so a hang surfaces as :class:`BatchTimeout`).
+        ``worker-*`` specs are skipped — they are the supervisor's to
+        deliver (:meth:`worker_signal`), not in-process raises."""
         for spec in self.specs:
+            if spec.mode in _WORKER_FAULT_SIGNALS:
+                continue
             if not spec.matches(batch, contracts):
                 continue
             spec.fired += 1
@@ -312,6 +347,30 @@ class FaultInjector:
                 raise ResourceExhausted(
                     f"injected RESOURCE_EXHAUSTED: out of memory "
                     f"(batch={batch})")
+
+    def worker_signal(self, batch: Optional[int] = None,
+                      contracts: Sequence[str] = ()) -> Optional[int]:
+        """Signal number of the first matching ``worker-kill`` /
+        ``worker-segv`` spec (the supervisor delivers it to the engine
+        worker subprocess right before dispatching the batch, so the
+        batch attempt observes an externally-killed worker), or None.
+        ``worker-kill:nth=K`` counts THIS process's worker-batch
+        dispatches — K specs with nth=1..K model a crash loop. EVERY
+        worker spec sees every dispatch (no early return), so stacked
+        nth counters stay aligned."""
+        hit: Optional[int] = None
+        for spec in self.specs:
+            sig = _WORKER_FAULT_SIGNALS.get(spec.mode)
+            if sig is None:
+                continue
+            if not spec.matches(batch, contracts):
+                continue
+            if hit is None:
+                spec.fired += 1
+                self.log.append({"mode": spec.mode, "batch": batch,
+                                 "contracts": list(contracts)})
+                hit = sig
+        return hit
 
 
 # --- backend management ----------------------------------------------
@@ -412,9 +471,402 @@ class BackendManager:
         return ok
 
 
+# --- supervised engine worker (docs/resilience.md) ---------------------
+
+
+class WorkerSupervisor:
+    """Parent-side supervisor of ONE engine-worker subprocess
+    (mythril_tpu/engine_worker.py): the worker owns the JAX backend and
+    runs device batches; this class owns the worker.
+
+    The isolation contract: a libtpu segfault, an OOM kill, or a hard
+    hang inside the worker surfaces HERE as :class:`WorkerDied` /
+    :class:`BatchTimeout` — a recoverable event the campaign's
+    retry→ladder→bisect machinery already knows how to replay — never
+    as parent-process death. Three layers:
+
+    - **per-batch deadline, enforced from the parent** — the reply is
+      awaited with ``select`` on the raw pipe fd; expiry SIGKILLs the
+      worker (a wedged libtpu call cannot be interrupted any other
+      way) and raises :class:`BatchTimeout`;
+    - **restart with capped exponential backoff** — consecutive deaths
+      double the respawn delay up to ``backoff_cap``, so a dying
+      backend is probed, not hammered;
+    - **crash-loop circuit breaker** — ``breaker_threshold`` deaths
+      within ``breaker_window`` seconds open the breaker:
+      :meth:`run_batch` raises :class:`WorkerCrashLoop` (the campaign
+      pins the batch to the in-process CPU path) until
+      ``breaker_cooldown`` lapses, then ONE half-open attempt decides
+      whether to close (success) or re-open (another death).
+
+    Every transition lands as a ``worker_spawn`` / ``worker_death`` /
+    ``worker_restart`` / ``breaker_open`` / ``breaker_close`` event
+    (via ``on_event`` — the campaign routes them into
+    ``backend_events`` + the trace bus) and on the metrics registry
+    (``engine_worker_{spawns,deaths,restarts}_total``,
+    ``engine_worker_rss_bytes``, ``engine_worker_breaker_open``).
+
+    ``stub=True`` spawns the protocol-only worker (no engine import) —
+    the fast path for supervision-machinery tests; the child process,
+    pipes, signals and deaths are all real either way.
+    """
+
+    def __init__(self, config: Optional[Dict] = None, *,
+                 stub: bool = False,
+                 batch_timeout: Optional[float] = None,
+                 spawn_timeout: float = 300.0,
+                 backoff_base: float = 0.5, backoff_cap: float = 30.0,
+                 breaker_threshold: int = 3,
+                 breaker_window: float = 60.0,
+                 breaker_cooldown: float = 30.0,
+                 fault_injector: Optional[FaultInjector] = None,
+                 on_event: Optional[Callable] = None,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.config = dict(config or {})
+        self.stub = bool(stub)
+        self.batch_timeout = batch_timeout
+        self.spawn_timeout = float(spawn_timeout)
+        self.backoff_base = max(0.0, float(backoff_base))
+        self.backoff_cap = max(0.0, float(backoff_cap))
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.breaker_window = max(0.01, float(breaker_window))
+        self.breaker_cooldown = max(0.0, float(breaker_cooldown))
+        self.fault_injector = fault_injector
+        self.on_event = on_event
+        self.worker_env = dict(worker_env or {})
+        self.proc: Optional[subprocess.Popen] = None
+        self.events: List[Dict] = []
+        self.restarts = 0
+        self.spawns = 0
+        self.rss_bytes = 0
+        self._deaths: "collections.deque[float]" = collections.deque()
+        self._consecutive = 0
+        self._breaker_opened: Optional[float] = None
+        self._lock = threading.RLock()
+
+    # --- events / metrics ----------------------------------------------
+    def _event(self, kind: str, detail: str = "", **kw) -> None:
+        e = {"kind": kind, "detail": detail[:300],
+             "t": round(time.time(), 3)}
+        e.update(kw)
+        self.events.append(e)
+        if self.on_event is not None:
+            self.on_event(kind, detail=detail[:300], **kw)
+        else:
+            from .obs import trace as obs_trace
+
+            obs_trace.event(kind, **{k: v for k, v in e.items()
+                                     if k != "kind"})
+
+    def _counter(self, name: str, help: str = ""):
+        from .obs import metrics as obs_metrics
+
+        return obs_metrics.REGISTRY.counter(name, help=help)
+
+    def _gauge(self, name: str, help: str = ""):
+        from .obs import metrics as obs_metrics
+
+        return obs_metrics.REGISTRY.gauge(name, help=help)
+
+    # --- breaker --------------------------------------------------------
+    def breaker_state(self) -> str:
+        """``closed`` | ``open`` | ``half-open`` (cooldown lapsed; the
+        next :meth:`run_batch` probes the worker once)."""
+        if self._breaker_opened is None:
+            return "closed"
+        if time.monotonic() - self._breaker_opened < self.breaker_cooldown:
+            return "open"
+        return "half-open"
+
+    def status(self) -> Dict:
+        with self._lock:
+            return {"alive": self.alive(),
+                    "pid": self.proc.pid if self.proc else None,
+                    "stub": self.stub,
+                    "spawns": self.spawns,
+                    "restarts": self.restarts,
+                    "deaths_in_window": len(self._deaths),
+                    "breaker": self.breaker_state(),
+                    "rss_bytes": self.rss_bytes}
+
+    def _check_breaker(self) -> None:
+        state = self.breaker_state()
+        if state == "open":
+            raise WorkerCrashLoop(
+                f"engine-worker breaker open ({len(self._deaths)} "
+                f"deaths within {self.breaker_window:.0f}s); work is "
+                f"pinned to the in-process CPU path for "
+                f"{self.breaker_cooldown:.0f}s")
+        if state == "half-open":
+            self._event("breaker_half_open",
+                        detail="cooldown lapsed; probing the worker "
+                               "with one live batch")
+
+    def _record_death(self, detail: str) -> None:
+        now = time.monotonic()
+        self._deaths.append(now)
+        while self._deaths and now - self._deaths[0] > self.breaker_window:
+            self._deaths.popleft()
+        self._consecutive += 1
+        rc = self.proc.poll() if self.proc is not None else None
+        self._counter("engine_worker_deaths_total",
+                      help="engine-worker subprocess deaths observed "
+                           "by the supervisor").inc()
+        self._event("worker_death", detail=detail, rc=rc,
+                    deaths_in_window=len(self._deaths))
+        self._reap()
+        if self._breaker_opened is not None:
+            # the half-open probe died: re-open for a fresh cooldown
+            self._breaker_opened = now
+            self._event("breaker_open",
+                        detail="half-open probe died; breaker re-opened")
+            self._gauge("engine_worker_breaker_open",
+                        help="1 while the crash-loop breaker is open").set(1)
+        elif len(self._deaths) >= self.breaker_threshold:
+            self._breaker_opened = now
+            self._counter("engine_worker_breaker_opens_total",
+                          help="crash-loop breaker open transitions").inc()
+            self._event("breaker_open",
+                        detail=f"{len(self._deaths)} worker deaths "
+                               f"within {self.breaker_window:.0f}s; "
+                               "pinning work to the in-process CPU "
+                               "path")
+            self._gauge("engine_worker_breaker_open",
+                        help="1 while the crash-loop breaker is open").set(1)
+
+    def _note_success(self) -> None:
+        self._consecutive = 0
+        if self._breaker_opened is not None:
+            self._breaker_opened = None
+            self._deaths.clear()
+            self._event("breaker_close",
+                        detail="half-open probe succeeded; worker path "
+                               "restored")
+            self._gauge("engine_worker_breaker_open",
+                        help="1 while the crash-loop breaker is open").set(0)
+
+    # --- process lifecycle ---------------------------------------------
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def _exit_code(self) -> Optional[int]:
+        """The worker's exit code right after an EOF: the pipe closes a
+        beat before the process is waitable, so give it a moment —
+        ``-11`` vs ``-9`` in the death event is real diagnostic
+        signal."""
+        if self.proc is None:
+            return None
+        try:
+            return self.proc.wait(timeout=2)
+        except (subprocess.TimeoutExpired, OSError):
+            return self.proc.poll()
+
+    def _reap(self) -> None:
+        if self.proc is None:
+            return
+        try:
+            if self.proc.poll() is None:
+                self.proc.kill()
+            self.proc.wait(timeout=5)
+        except (OSError, subprocess.TimeoutExpired):
+            pass  # unkillable (D-state): abandon, like the probe child
+        for stream in (self.proc.stdin, self.proc.stdout):
+            try:
+                if stream is not None:
+                    stream.close()
+            except OSError:
+                pass
+        self.proc = None
+
+    def _spawn_and_init(self) -> None:
+        """Spawn + init-handshake one worker, honoring the restart
+        backoff. Raises :class:`WorkerDied` when the worker cannot come
+        up (counted as a death — a failing init IS the crash loop)."""
+        if self._consecutive > 0:
+            delay = min(self.backoff_cap,
+                        self.backoff_base * (2 ** (self._consecutive - 1)))
+            if delay > 0:
+                time.sleep(delay)
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, %r); "
+             "from mythril_tpu.engine_worker import worker_main; "
+             "raise SystemExit(worker_main())" % root],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
+        self.spawns += 1
+        self._counter("engine_worker_spawns_total",
+                      help="engine-worker subprocesses spawned").inc()
+        if self.spawns > 1:
+            self.restarts += 1
+            self._counter("engine_worker_restarts_total",
+                          help="engine-worker respawns after a "
+                               "death").inc()
+            self._event("worker_restart", pid=self.proc.pid,
+                        attempt=self.spawns,
+                        detail=f"respawn #{self.restarts}")
+        self._event("worker_spawn", pid=self.proc.pid,
+                    detail="stub" if self.stub else "engine")
+        try:
+            self._send({"op": "init", "stub": self.stub,
+                        "config": self.config})
+            rep = self._read_frame(time.monotonic() + self.spawn_timeout)
+        except TimeoutError:
+            self._record_death(
+                f"worker init exceeded {self.spawn_timeout:.0f}s; "
+                "killed")
+            raise WorkerDied(
+                f"engine worker init hung >{self.spawn_timeout:.0f}s "
+                "(killed)") from None
+        except (EOFError, OSError):
+            rc = self._exit_code()
+            self._record_death(f"worker died during init (rc={rc})")
+            raise WorkerDied(
+                f"engine worker died during init (rc={rc})") from None
+        if not rep.get("ok"):
+            # the worker is alive but could not build its engine (bad
+            # config, missing dep): not a crash, but not usable either
+            self._reap()
+            raise self._rehydrate(rep)
+
+    def close(self) -> None:
+        """Orderly shutdown: ask the worker to exit, then reap."""
+        with self._lock:
+            if self.alive():
+                try:
+                    self._send({"op": "exit"})
+                    self.proc.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired, TimeoutError):
+                    pass
+            self._reap()
+
+    # --- framed IPC (length-prefixed pickle over the pipes) -------------
+    def _send(self, msg: Dict) -> None:
+        from .engine_worker import pack_frame
+
+        self.proc.stdin.write(pack_frame(msg))
+        self.proc.stdin.flush()
+
+    def _read_frame(self, deadline: Optional[float]) -> Dict:
+        """One reply frame from the worker, or TimeoutError (deadline)
+        / EOFError (worker death, incl. a torn mid-reply frame)."""
+        import pickle
+
+        from .engine_worker import FRAME_HEADER
+
+        hdr = self._read_exact(FRAME_HEADER.size, deadline)
+        (n,) = FRAME_HEADER.unpack(hdr)
+        return pickle.loads(self._read_exact(n, deadline))
+
+    def _read_exact(self, n: int, deadline: Optional[float]) -> bytes:
+        fd = self.proc.stdout.fileno()
+        buf = b""
+        while len(buf) < n:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError()
+                wait = min(remaining, 0.5)
+            else:
+                wait = 0.5
+            ready, _, _ = select.select([fd], [], [], wait)
+            if not ready:
+                if self.proc.poll() is not None:
+                    raise EOFError()
+                continue
+            chunk = os.read(fd, n - len(buf))
+            if not chunk:
+                raise EOFError()
+            buf += chunk
+        return buf
+
+    def _rehydrate(self, rep: Dict) -> BaseException:
+        """Parent-side exception for a worker error reply, typed so the
+        existing recovery paths (ladder / re-probe / bisect) classify
+        it exactly like an in-process failure."""
+        msg = f"{rep.get('etype', 'Error')}: {rep.get('emsg', '')}"[:500]
+        kind = rep.get("classify")
+        if kind == "oom":
+            return ResourceExhausted(msg)
+        if kind == "device-lost":
+            return DeviceLostError(msg)
+        return WorkerError(msg)
+
+    def _update_rss(self) -> None:
+        try:
+            with open(f"/proc/{self.proc.pid}/statm") as fh:
+                pages = int(fh.read().split()[1])
+            self.rss_bytes = pages * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, ValueError, IndexError, AttributeError):
+            return
+        self._gauge("engine_worker_rss_bytes",
+                    help="resident set size of the engine worker "
+                         "subprocess").set(self.rss_bytes)
+
+    # --- the one entry point -------------------------------------------
+    def run_batch(self, bi: int, names: Sequence[str],
+                  codes: Sequence[bytes],
+                  lanes: Optional[int] = None,
+                  width: Optional[int] = None,
+                  on_cpu: bool = False) -> Dict:
+        """Run one batch in the worker under the parent-side deadline.
+        Raises :class:`WorkerCrashLoop` (breaker open),
+        :class:`BatchTimeout` (deadline; worker killed),
+        :class:`WorkerDied` (crash mid-batch), or the rehydrated typed
+        error the worker reported. Returns the batch's partial-result
+        dict (``issues``/``paths``/``dropped``/``iprof``)."""
+        with self._lock:
+            self._check_breaker()
+            if not self.alive():
+                self._spawn_and_init()
+            if self.fault_injector is not None:
+                sig = self.fault_injector.worker_signal(
+                    batch=bi, contracts=names)
+                if sig is not None:
+                    try:
+                        os.kill(self.proc.pid, sig)
+                    except OSError:
+                        pass
+            deadline = (time.monotonic() + self.batch_timeout
+                        if self.batch_timeout is not None else None)
+            try:
+                self._send({"op": "batch", "bi": int(bi),
+                            "names": [str(x) for x in names],
+                            "codes": [bytes(c) for c in codes],
+                            "lanes": lanes, "width": width,
+                            "on_cpu": bool(on_cpu)})
+                rep = self._read_frame(deadline)
+            except TimeoutError:
+                self._record_death(
+                    f"batch {bi} exceeded {self.batch_timeout:.1f}s; "
+                    "worker killed")
+                raise BatchTimeout(
+                    f"batch {bi} exceeded {self.batch_timeout:.1f}s "
+                    "wall-clock budget in the engine worker (worker "
+                    "killed)") from None
+            except (EOFError, OSError):
+                rc = self._exit_code()
+                self._record_death(f"worker died mid-batch {bi} (rc={rc})")
+                raise WorkerDied(
+                    f"engine worker died mid-batch {bi} (rc={rc})"
+                ) from None
+            if not rep.get("ok"):
+                # an error REPLY means the worker survived: the fault
+                # was contained inside the engine, not the process
+                self._note_success()
+                self._update_rss()
+                raise self._rehydrate(rep)
+            self._note_success()
+            self._update_rss()
+            return rep["value"]
+
+
 __all__ = [
     "BackendManager", "BatchTimeout", "DEGRADE_RUNGS", "DeviceLostError",
     "FaultInjector", "FaultSpec", "InjectedKill", "ResilienceError",
-    "ResourceExhausted", "classify_backend_error", "parse_ladder",
+    "ResourceExhausted", "WorkerCrashLoop", "WorkerDied", "WorkerError",
+    "WorkerSupervisor", "classify_backend_error", "parse_ladder",
     "run_with_watchdog",
 ]

@@ -65,6 +65,12 @@ class ServeOptions:
     oom_ladder: Optional[Sequence[str]] = None
     fault_inject: Optional[str] = None
     concrete_storage: bool = False
+    #: engine-worker process isolation (docs/resilience.md): "auto"
+    #: resolves to ON under serve — backend death must be a worker
+    #: restart, not daemon death. Operational (excluded from the
+    #: dedupe config hash): flipping it must not split the verdict
+    #: cache.
+    worker_isolation: str = "auto"
     #: per-request overrides accepted in the submit body's ``options``
     OVERRIDABLE = ("max_steps", "transaction_count", "modules")
 
@@ -100,6 +106,7 @@ class ServeOptions:
                            if self.oom_ladder is not None else None),
             "fault_inject": self.fault_inject,
             "concrete_storage": self.concrete_storage,
+            "worker_isolation": self.worker_isolation,
         }
         return cfg
 
@@ -154,7 +161,7 @@ class AnalysisDaemon:
         from ..smt import portfolio as smt_portfolio
 
         vstore = smt_portfolio.get_store()
-        return {
+        doc = {
             "ok": True,
             "state": self.state,
             "queue_depth": self.queue.depth(),
@@ -164,7 +171,20 @@ class AnalysisDaemon:
             "solver_verdicts": vstore.count() if vstore else 0,
             "uptime_sec": round(time.monotonic() - self.t_start, 3),
             "pid": os.getpid(),
+            "engine_worker_restarts": self.scheduler.worker_restarts(),
         }
+        # a dead scheduler loop degrades the whole daemon (requests
+        # would never schedule); an OPEN crash-loop breaker degrades
+        # one config (its batches run pinned to in-process CPU) while
+        # everything else serves normally — orchestrators see both
+        if self.scheduler.crashed:
+            doc["ok"] = False
+            doc["state"] = "degraded"
+            doc["error"] = f"scheduler loop died: {self.scheduler.crashed}"
+        degraded = self.scheduler.degraded_configs()
+        if degraded:
+            doc["degraded_configs"] = degraded
+        return doc
 
     @property
     def port(self) -> int:

@@ -54,6 +54,12 @@ def default_campaign_factory(config: Dict):
         from ..symbolic import SymSpec
 
         spec = SymSpec(storage=False)
+    # worker isolation (docs/resilience.md): "auto" means ON under
+    # serve — an always-on daemon is exactly where a libtpu segfault
+    # must be a worker restart, not daemon death
+    isolation = config.get("worker_isolation", "auto")
+    if isolation == "auto":
+        isolation = "on"
     return CorpusCampaign(
         [],
         batch_size=int(config.get("batch_size", 8)),
@@ -71,6 +77,7 @@ def default_campaign_factory(config: Dict):
             config.get("fault_inject")),
         oom_ladder=config.get("oom_ladder"),
         solver_workers=int(config.get("solver_workers", 1)),
+        worker_isolation=isolation,
     )
 
 
@@ -100,6 +107,11 @@ class Scheduler:
         self._abort = threading.Event()    # give up on fleet pending
         self._thread: Optional[threading.Thread] = None
         self.batches_run = 0
+        #: set to "<Type>: <msg>" if the loop thread dies of an
+        #: unhandled error — /healthz flips to "degraded" and every
+        #: pending request fails immediately instead of hanging until
+        #: its deadline
+        self.crashed: Optional[str] = None
         self._reg = obs_metrics.REGISTRY
 
     # --- lifecycle ------------------------------------------------------
@@ -133,6 +145,58 @@ class Scheduler:
 
     # --- the loop -------------------------------------------------------
     def _loop(self) -> None:
+        """Crash containment around the real loop: if the scheduler
+        thread dies of an unhandled error, pending requests used to
+        hang until their deadlines — now they FAIL immediately with
+        the error string, the queue closes (new submissions get 503),
+        and ``/healthz`` reports ``degraded``. The daemon keeps
+        serving reads (results, metrics, health) — dying quietly is
+        the one thing the loop may not do."""
+        try:
+            self._loop_inner()
+        except Exception as e:  # noqa: BLE001 — the containment seam
+            self.crashed = f"{type(e).__name__}: {str(e)[:300]}"
+            log.exception("serve scheduler loop died")
+            self._reg.counter(
+                "serve_scheduler_crashes_total",
+                help="unhandled errors that killed the scheduler "
+                     "loop").inc()
+            obs_trace.event("scheduler_crashed", detail=self.crashed)
+            try:
+                self.queue.close()
+                self.queue.fail_pending(
+                    f"scheduler loop died ({self.crashed}); restart "
+                    "the daemon — completed contracts will be served "
+                    "from the dedupe store")
+            except Exception:  # noqa: BLE001 — best-effort unblock
+                log.exception("failing pending entries after "
+                              "scheduler crash")
+            for uid, entries in list(self._pending.items()):
+                for en in entries:
+                    self.queue.resolve(
+                        en, {"status": "error",
+                             "error": f"scheduler loop died before "
+                                      f"fleet unit {uid} committed "
+                                      f"({self.crashed})"})
+            self._pending.clear()
+        finally:
+            for camp in list(self._campaigns.values()):
+                close = getattr(camp, "close_worker", None)
+                if callable(close):
+                    try:
+                        close()
+                    except Exception:  # noqa: BLE001 — exit path
+                        log.exception("closing engine worker")
+            if self._ledger is not None:
+                # tell --fleet-follow workers the feed is complete so
+                # they drain and exit instead of polling a dead
+                # daemon's ledger
+                try:
+                    self._ledger.feed_close()
+                except OSError:
+                    pass
+
+    def _loop_inner(self) -> None:
         while True:
             if self._ledger is not None:
                 self._poll_fleet()
@@ -171,13 +235,6 @@ class Scheduler:
                              "error": "daemon exited before fleet "
                                       f"unit {uid} committed"})
             self._pending.clear()
-        if self._ledger is not None:
-            # tell --fleet-follow workers the feed is complete so they
-            # drain and exit instead of polling a dead daemon's ledger
-            try:
-                self._ledger.feed_close()
-            except OSError:
-                pass
 
     # --- local (resident-campaign) execution ----------------------------
     def _campaign_for(self, e: Entry):
@@ -290,6 +347,32 @@ class Scheduler:
 
     def pending_fleet_units(self) -> int:
         return len(self._pending)
+
+    # --- worker supervision surface (docs/resilience.md) ----------------
+    def degraded_configs(self) -> List[Dict]:
+        """Configs whose engine-worker crash-loop breaker is not
+        closed — ``/healthz`` reports them so an orchestrator can see
+        "this daemon serves, but config X runs pinned to CPU"."""
+        out: List[Dict] = []
+        for cfh, camp in list(self._campaigns.items()):
+            status = getattr(camp, "worker_status", None)
+            st = status() if callable(status) else None
+            if st is not None and st.get("breaker") != "closed":
+                out.append({"config": cfh, "breaker": st["breaker"],
+                            "deaths_in_window": st.get(
+                                "deaths_in_window"),
+                            "restarts": st.get("restarts")})
+        return out
+
+    def worker_restarts(self) -> int:
+        """Total engine-worker respawns across resident campaigns."""
+        n = 0
+        for camp in list(self._campaigns.values()):
+            status = getattr(camp, "worker_status", None)
+            st = status() if callable(status) else None
+            if st is not None:
+                n += int(st.get("restarts", 0))
+        return n
 
 
 __all__ = ["Scheduler", "default_campaign_factory"]

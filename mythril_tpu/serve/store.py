@@ -51,7 +51,7 @@ def bytecode_hash(code: bytes) -> str:
 #: changes which paths survive.
 OPERATIONAL_KEYS = frozenset((
     "fault_inject", "batch_timeout", "max_batch_retries", "oom_ladder",
-    "solver_workers", "batch_size"))
+    "solver_workers", "batch_size", "worker_isolation"))
 
 
 def config_hash(config: Dict) -> str:

@@ -44,3 +44,8 @@ if os.environ.get("MYTHRIL_NO_JAX_CACHE") != "1":
         f".jax_cache_{_worker}")
     jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # engine-worker SUBPROCESSES (mythril_tpu/engine_worker.py) share
+    # the same persistent cache via this env var — jax.config updates
+    # don't cross the spawn, and a cold worker would otherwise pay the
+    # full superstep compile on this one-core box
+    os.environ.setdefault("MYTHRIL_WORKER_JAX_CACHE", _CACHE_DIR)

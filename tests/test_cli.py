@@ -259,6 +259,20 @@ def test_parser_round5_parity_flags():
     assert args.statespace_json == "ss.json"
 
 
+def test_parser_worker_isolation_flag():
+    p = create_parser()
+    args = p.parse_args(["analyze", "--corpus", "x"])
+    assert args.worker_isolation == "auto"      # on under --fleet only
+    args = p.parse_args(["analyze", "--corpus", "x",
+                         "--worker-isolation", "on"])
+    assert args.worker_isolation == "on"
+    args = p.parse_args(["serve", "--worker-isolation", "off"])
+    assert args.worker_isolation == "off"
+    with pytest.raises(SystemExit):
+        p.parse_args(["analyze", "--corpus", "x",
+                      "--worker-isolation", "sometimes"])
+
+
 def test_flag_max_depth_overrides_max_steps(capsys):
     # --max-depth (reference name) wins over the default --max-steps
     rc, out = run_cli(

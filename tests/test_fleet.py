@@ -157,6 +157,76 @@ def test_renewer_heartbeat_prevents_reclaim(tmp_path):
     assert got is not None and got.attempt == 2
 
 
+def test_renew_failure_is_loud_and_retried(tmp_path):
+    """A failed heartbeat renew (here: the lease vanished underneath
+    us — the reclaimed-from case) emits ``lease_renew_failed`` + the
+    ``fleet_renew_failures_total`` counter instead of silently doing
+    nothing, and a later renew with the lease back succeeds — the
+    renewer retries every tick rather than dying quietly."""
+    from mythril_tpu.obs import metrics as obs_metrics
+
+    events = []
+    led = WorkLedger(str(tmp_path / "l"), ttl=5.0, worker="a",
+                     on_event=lambda kind, **kw: events.append(
+                         dict(kind=kind, **kw)))
+    led.ensure(CONTRACTS[:2], unit_size=2)
+    unit = led.claim_next()
+    fails0 = obs_metrics.REGISTRY.counter(
+        "fleet_renew_failures_total").value
+    os.unlink(led._lease_path(unit.uid))     # yank the lease
+    led.renew(unit)
+    led.renew(unit)                          # every tick reports
+    fail_events = [e for e in events
+                   if e["kind"] == "lease_renew_failed"]
+    assert len(fail_events) == 2
+    assert fail_events[0]["unit"] == unit.uid
+    assert "retrying next tick" in fail_events[0]["detail"]
+    assert obs_metrics.REGISTRY.counter(
+        "fleet_renew_failures_total").value - fails0 == 2
+    # the lease comes back (e.g. transient NFS blip): renew works again
+    with open(led._lease_path(unit.uid), "w") as fh:
+        json.dump({"worker": "a", "attempt": 1}, fh)
+    led.renew(unit)
+    assert len([e for e in events
+                if e["kind"] == "lease_renew_failed"]) == 2
+
+
+def test_torn_result_file_set_aside_and_reclaimed(tmp_path):
+    """A torn/corrupt committed-result file (external truncation — the
+    chaos matrix's torn-ledger row) used to block its unit forever:
+    unclaimable (the name existed) yet unreadable (no parse). Now the
+    sweep sets it aside as ``.corrupt`` with an event, the unit is
+    re-claimable, and the re-run's commit wins the freed name."""
+    events = []
+    led = WorkLedger(str(tmp_path / "l"), ttl=5.0, worker="a",
+                     on_event=lambda kind, **kw: events.append(
+                         dict(kind=kind, **kw)))
+    led.ensure(CONTRACTS[:2], unit_size=2)   # one unit
+    unit = led.claim_next()
+    assert led.commit(unit, {"unit": unit.uid, "contracts": ["c000",
+                                                             "c001"]})
+    assert not led.pending()
+    # tear the committed result mid-byte (fresh ledger view: the
+    # verified-cache of the committing ledger must not mask the check)
+    p = led._result_path(unit.uid)
+    raw = open(p, "rb").read()
+    with open(p, "wb") as fh:
+        fh.write(raw[:len(raw) // 2])
+    led2 = WorkLedger(str(tmp_path / "l"), ttl=5.0, worker="b",
+                      on_event=lambda kind, **kw: events.append(
+                          dict(kind=kind, **kw)))
+    led2.load_manifest()
+    assert led2.pending()                    # torn result ≠ committed
+    got = led2.claim_next()
+    assert got is not None and got.uid == unit.uid
+    assert os.path.exists(p + ".corrupt")    # evidence preserved
+    assert [e for e in events if e["kind"] == "unit_result_corrupt"]
+    # the re-run commits into the freed name
+    assert led2.commit(got, {"unit": got.uid, "contracts": ["c000",
+                                                            "c001"]})
+    assert json.load(open(p))["unit"] == got.uid
+
+
 def test_release_cap_marks_unit_lost(tmp_path):
     """Acceptance: bounded re-lease — a unit that keeps killing its
     workers is marked lost (the fleet analog of bisect-to-quarantine),

@@ -512,3 +512,174 @@ def test_e2e_dedupe_and_warm_compile_real_engine(tmp_path):
     finally:
         dm.shutdown("test")
     assert dm.state == "stopped"
+
+
+# --- scheduler crash containment (docs/resilience.md) -------------------
+
+def test_scheduler_crash_fails_pending_and_degrades_health(
+        daemon_factory, monkeypatch):
+    """If the scheduler loop thread dies of an unhandled error,
+    pending requests fail IMMEDIATELY (they used to hang until their
+    deadlines), /healthz flips to degraded with the error string, and
+    new submissions get 503."""
+    gate = threading.Event()
+    started = threading.Event()
+
+    class TrackedStub(StubCampaign):
+        def run_external_batch(self, items, bi=None):
+            started.set()
+            return super().run_external_batch(items, bi)
+
+    stub = TrackedStub(gate=gate)
+    dm, url = daemon_factory(stub=stub)
+    # batch A occupies the scheduler (gate held) ...
+    snap_a = _submit(url, [("a", ISSUE_CODE)])
+    assert started.wait(20.0)
+    # ... B queues behind it; the crash is armed for the NEXT pop
+    snap_b = _submit(url, [("b", b"\x01" + bytes([8]))])
+    monkeypatch.setattr(
+        dm.queue, "pop_batch",
+        lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("chaos: scheduler eats it")))
+    gate.set()  # A completes; the loop's next pop dies
+    out_a = serve_client.get_result(url, snap_a["id"], wait=20.0)
+    assert out_a["results"][0]["status"] == "ok"   # in-flight work landed
+    out_b = serve_client.get_result(url, snap_b["id"], wait=20.0)
+    assert out_b["state"] == "done"                # failed FAST, no hang
+    (r,) = out_b["results"]
+    assert r["status"] == "error"
+    assert "scheduler loop died" in r["error"]
+    assert "chaos: scheduler eats it" in r["error"]
+    health = serve_client.healthz(url)
+    assert health["state"] == "degraded" and health["ok"] is False
+    assert "chaos: scheduler eats it" in health["error"]
+    assert dm.scheduler.crashed
+    # the queue closed with the crash: new submissions 503 fast
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _submit(url, [("late", CLEAN_CODE)])
+    assert ei.value.code == 503
+
+
+def test_healthz_reports_degraded_worker_configs(daemon_factory):
+    """An open engine-worker crash-loop breaker surfaces per config in
+    /healthz degraded_configs while the daemon keeps serving."""
+    stub = StubCampaign()
+    dm, url = daemon_factory(stub=stub)
+
+    class _BrokenWorkerCampaign:
+        def worker_status(self):
+            return {"breaker": "open", "deaths_in_window": 3,
+                    "restarts": 5, "alive": False}
+
+    dm.scheduler._campaigns["cfh-broken"] = _BrokenWorkerCampaign()
+    health = serve_client.healthz(url)
+    assert health["state"] == "serving"     # still serving other work
+    (dc,) = health["degraded_configs"]
+    assert dc["config"] == "cfh-broken" and dc["breaker"] == "open"
+    assert dc["restarts"] == 5
+    assert health["engine_worker_restarts"] == 5
+    # the daemon still answers real work alongside the degraded config
+    snap = _submit(url, [("ok", ISSUE_CODE)])
+    out = serve_client.get_result(url, snap["id"], wait=20.0)
+    assert out["results"][0]["status"] == "ok"
+
+
+# --- client retry (tools/serve_client.py) --------------------------------
+
+def test_client_with_retry_connection_errors(monkeypatch):
+    monkeypatch.setattr(serve_client.time, "sleep", lambda s: None)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise urllib.error.URLError(ConnectionRefusedError(111))
+        return {"ok": True}
+
+    assert serve_client.with_retry(flaky, retries=3) == {"ok": True}
+    assert len(calls) == 3
+    # exhausted budget raises the live error
+    calls.clear()
+    with pytest.raises(urllib.error.URLError):
+        serve_client.with_retry(flaky, retries=1)
+    assert len(calls) == 2
+
+
+def test_client_with_retry_503_drain_only(monkeypatch):
+    monkeypatch.setattr(serve_client.time, "sleep", lambda s: None)
+
+    def http_err(code):
+        return urllib.error.HTTPError("u", code, "x", {}, None)
+
+    calls = []
+
+    def draining():
+        calls.append(1)
+        if len(calls) < 2:
+            raise http_err(503)
+        return {"ok": True}
+
+    assert serve_client.with_retry(draining, retries=2) == {"ok": True}
+    # 4xx is the CALLER's bug: never retried
+    calls.clear()
+
+    def bad_request():
+        calls.append(1)
+        raise http_err(400)
+
+    with pytest.raises(urllib.error.HTTPError):
+        serve_client.with_retry(bad_request, retries=5)
+    assert len(calls) == 1
+    # retries=0 is the legacy fail-fast contract
+    calls.clear()
+
+    def down():
+        calls.append(1)
+        raise http_err(503)
+
+    with pytest.raises(urllib.error.HTTPError):
+        serve_client.with_retry(down, retries=0)
+    assert len(calls) == 1
+
+
+def test_client_retry_rides_daemon_restart(tmp_path):
+    """The restart story end to end: submit to a live daemon, kill it,
+    then a get_result with retries spans the gap to a restarted daemon
+    on the SAME port serving from the dedupe store."""
+    data_dir = str(tmp_path / "restart_data")
+    stub = StubCampaign()
+    dm = AnalysisDaemon(data_dir=data_dir, port=0,
+                        campaign_factory=lambda cfg: stub,
+                        options=ServeOptions(batch_size=4))
+    dm.start()
+    port = dm.port
+    url = f"http://127.0.0.1:{port}"
+    snap = _submit(url, [("k", ISSUE_CODE)])
+    out = serve_client.get_result(url, snap["id"], wait=20.0)
+    assert out["state"] == "done"
+    dm.shutdown("test restart")
+
+    result = {}
+
+    def client():
+        # the daemon is DOWN when this starts: only the retry loop
+        # (connection refused -> backoff -> reconnect) can succeed
+        result["snap"] = serve_client.submit(
+            url, [("k2", ISSUE_CODE)], retries=8, backoff=0.1)
+
+    t = threading.Thread(target=client)
+    t.start()
+    time.sleep(0.3)
+    dm2 = AnalysisDaemon(data_dir=data_dir, port=port,
+                         campaign_factory=lambda cfg: StubCampaign(),
+                         options=ServeOptions(batch_size=4))
+    dm2.start()
+    try:
+        t.join(20.0)
+        assert not t.is_alive()
+        snap2 = result["snap"]
+        # same bytecode+config: served straight from the durable store
+        assert snap2["completed"] == 1
+        assert snap2["results"][0]["served_from"] == "dedupe-store"
+    finally:
+        dm2.shutdown("test")

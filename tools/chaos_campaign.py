@@ -1,0 +1,391 @@
+#!/usr/bin/env python
+"""Chaos fault-matrix runner: injection points x execution modes, with
+issue-set parity and exactly-once accounting asserted per cell.
+
+The acceptance harness for the process-isolation boundary
+(docs/resilience.md "Process isolation & supervision"): every cell
+runs the SAME small corpus through one execution mode with one fault
+injected, then asserts
+
+- **parity** — the final issue set is identical to an uninjected
+  in-process baseline (same contracts flagged, same count: nothing
+  lost to the fault, nothing double-counted through the recovery);
+- **exactly-once** — mode-specific accounting closes: batch modes
+  leave a checkpoint cursor at the last batch with every contract
+  counted once, fleet mode closes a full coverage manifest (0 lost /
+  0 unaccounted), serve mode resolves every contract exactly once;
+- **the recovery actually happened** — worker deaths/restarts (or
+  lease reclaims, or corrupt-result set-asides) are on the event
+  record, not just absent-of-failure.
+
+Injection points (columns):
+
+  segv-mid-compile     SIGSEGV the engine worker before it touches the
+                       engine for batch 1 (dying inside the XLA
+                       compile, as libtpu does)
+  segv-mid-superstep   SIGSEGV after the device phase ran, before the
+                       host harvest (mid-batch state loss)
+  kill-mid-reply       SIGKILL halfway through writing the IPC reply
+                       (torn frame: the parent must treat a truncated
+                       reply as death, not data)
+  torn-ledger          truncate a COMMITTED fleet unit result file
+                       mid-byte (a misbehaving shared filesystem); the
+                       fleet must set it aside and re-analyze the unit
+  frozen-heartbeat     a worker claims a lease and never heartbeats
+                       (wedged before its first renew); a live worker
+                       must reclaim after the TTL
+
+Modes (rows): ``batch`` (serial campaign), ``pipelined`` (depth-1
+pipeline), ``fleet`` (work-ledger campaign), ``serve`` (in-process
+always-on daemon). Worker-signal points run with
+``worker_isolation=on``; ledger points exercise the fleet machinery
+directly. Not every point applies to every mode — see ``MATRIX``.
+
+CPU-only, TEST_LIMITS, deterministic (``once=`` cookie files make each
+worker fault fire exactly once across restarts). Prints one JSON line
+``{"ok": bool, "cells": {...}}`` and exits 0/1.
+
+    JAX_PLATFORMS=cpu python tools/chaos_campaign.py
+    JAX_PLATFORMS=cpu python tools/chaos_campaign.py \
+        --cells batch:segv-mid-superstep,fleet:torn-ledger
+
+The soak's ``chaos`` leg (tools/soak_campaign.py) runs the reduced
+two-cell matrix above; the full matrix is the pre-release gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_BATCH_TIMEOUT = float(os.environ.get("SOAK_BATCH_TIMEOUT", "300") or 300)
+
+#: point -> MYTHRIL_WORKER_FAULT template (cookie path appended)
+_WORKER_POINTS = {
+    "segv-mid-compile": "segv:mid-compile:1",
+    "segv-mid-superstep": "segv:mid-superstep:1",
+    "kill-mid-reply": "kill:mid-reply:1",
+}
+
+MATRIX: Dict[str, Tuple[str, ...]] = {
+    "batch": tuple(_WORKER_POINTS),
+    "pipelined": tuple(_WORKER_POINTS),
+    "fleet": tuple(_WORKER_POINTS) + ("torn-ledger", "frozen-heartbeat"),
+    "serve": tuple(_WORKER_POINTS),
+}
+
+N = 6  # distinct bytecodes (serve dedupe would collapse clones)
+
+
+def _corpus():
+    from mythril_tpu.disassembler.asm import assemble
+
+    return [(f"c{i:03d}",
+             assemble(i, "SELFDESTRUCT") if i % 2 == 0
+             else assemble(1, i, "SSTORE", "STOP"))
+            for i in range(N)]
+
+
+def _campaign(contracts, ckpt: Optional[str], **kw):
+    from mythril_tpu.config import TEST_LIMITS
+    from mythril_tpu.mythril.campaign import CorpusCampaign
+
+    kw.setdefault("batch_size", 2)
+    return CorpusCampaign(
+        contracts, lanes_per_contract=8, limits=TEST_LIMITS,
+        max_steps=64, transaction_count=1,
+        modules=["AccidentallyKillable"], checkpoint_dir=ckpt,
+        batch_timeout=_BATCH_TIMEOUT, **kw)
+
+
+def _issues(res) -> List[str]:
+    return sorted(i["contract"] for i in res.issues)
+
+
+def _worker_kinds(events) -> List[str]:
+    return [e.get("kind") for e in events
+            if str(e.get("kind", "")).startswith(("worker", "breaker"))]
+
+
+class _fault_env:
+    """MYTHRIL_WORKER_FAULT scoped to one cell, with a fresh once-
+    cookie so the fault fires exactly once across worker restarts."""
+
+    def __init__(self, point: str, d: str):
+        self.spec = (f"{_WORKER_POINTS[point]}"
+                     f":once={os.path.join(d, 'fault_cookie')}")
+
+    def __enter__(self):
+        os.environ["MYTHRIL_WORKER_FAULT"] = self.spec
+        return self
+
+    def __exit__(self, *exc):
+        os.environ.pop("MYTHRIL_WORKER_FAULT", None)
+        return False
+
+
+def _cell_batch(mode: str, point: str, d: str, contracts,
+                baseline: List[str]) -> Dict:
+    from mythril_tpu.utils.checkpoint import load_json_checkpoint
+
+    ckpt = os.path.join(d, "ck")
+    with _fault_env(point, d):
+        res = _campaign(contracts, ckpt, worker_isolation="on",
+                        pipeline=(mode == "pipelined")).run()
+    kinds = _worker_kinds(res.backend_events)
+    final = load_json_checkpoint(os.path.join(ckpt, "campaign.json"))
+    cell = {"issues": _issues(res), "retries": res.retries,
+            "quarantined": [q["name"] for q in res.quarantined],
+            "worker_events": kinds,
+            "next_batch": final.get("next_batch")}
+    cell["ok"] = (cell["issues"] == baseline
+                  and len(res.issues) == len(baseline)
+                  and not res.quarantined
+                  and kinds.count("worker_death") >= 1
+                  and kinds.count("worker_restart") >= 1
+                  and final.get("next_batch") == (N + 1) // 2)
+    return cell
+
+
+def _merge_fleet(res, fleet_dir: str) -> Dict:
+    from mythril_tpu.fleet import ledger_results
+    from mythril_tpu.mythril.campaign import merge_campaigns
+
+    doc = res.as_dict()
+    doc["issues_detail"] = res.issues
+    return merge_campaigns([doc] + ledger_results(fleet_dir))
+
+
+def _cell_fleet_worker(point: str, d: str, contracts,
+                       baseline: List[str]) -> Dict:
+    fl = os.path.join(d, "fleet")
+    with _fault_env(point, d):
+        res = _campaign(contracts, None, worker_isolation="on",
+                        fleet_dir=fl, lease_ttl=5.0,
+                        worker_id="w0").run()
+    merged = _merge_fleet(res, fl)
+    cov = merged.get("coverage") or {}
+    kinds = _worker_kinds(res.backend_events)
+    issues = sorted(i["contract"]
+                    for i in merged.get("issues_detail", []))
+    cell = {"issues": issues, "coverage": {
+        k: cov.get(k) for k in ("analyzed", "quarantined", "lost",
+                                "unaccounted", "full")},
+        "worker_events": kinds}
+    cell["ok"] = (issues == baseline
+                  and merged.get("issues") == len(baseline)
+                  and cov.get("full") is True
+                  and kinds.count("worker_death") >= 1)
+    return cell
+
+
+def _cell_torn_ledger(d: str, contracts, baseline: List[str]) -> Dict:
+    from mythril_tpu.resilience import FaultInjector, InjectedKill
+
+    fl = os.path.join(d, "fleet")
+    killed = False
+    try:
+        # w0 commits its first unit, then dies on its second attempt
+        _campaign(contracts, None, fleet_dir=fl, lease_ttl=0.5,
+                  worker_id="w0",
+                  fault_injector=FaultInjector.from_string(
+                      "kill:nth=2")).run()
+    except InjectedKill:
+        killed = True
+    units_dir = os.path.join(fl, "units")
+    committed = sorted(f for f in os.listdir(units_dir)
+                       if f.endswith(".result.json"))
+    torn = None
+    if committed:
+        torn = os.path.join(units_dir, committed[0])
+        raw = open(torn, "rb").read()
+        with open(torn, "wb") as fh:
+            fh.write(raw[:len(raw) // 2])
+    time.sleep(0.6)  # w0's remaining lease goes stale
+    res = _campaign(contracts, None, fleet_dir=fl, lease_ttl=0.5,
+                    worker_id="w1").run()
+    merged = _merge_fleet(res, fl)
+    cov = merged.get("coverage") or {}
+    kinds = [e.get("kind") for e in res.backend_events]
+    issues = sorted(i["contract"]
+                    for i in merged.get("issues_detail", []))
+    cell = {"killed": killed, "tore": bool(torn),
+            "issues": issues,
+            "corrupt_events": kinds.count("unit_result_corrupt"),
+            "coverage": {k: cov.get(k) for k in
+                         ("analyzed", "lost", "unaccounted", "full")}}
+    cell["ok"] = (killed and torn is not None
+                  and kinds.count("unit_result_corrupt") >= 1
+                  and cov.get("full") is True
+                  and issues == baseline
+                  and merged.get("issues") == len(baseline))
+    return cell
+
+
+def _cell_frozen_heartbeat(d: str, contracts,
+                           baseline: List[str]) -> Dict:
+    from mythril_tpu.fleet import WorkLedger
+
+    fl = os.path.join(d, "fleet")
+    # a worker claims one unit and freezes before its first renew: the
+    # lease exists, the heartbeat never moves
+    frozen = WorkLedger(fl, ttl=0.5, worker="w-frozen")
+    frozen.ensure(contracts, unit_size=2)
+    unit = frozen.claim_next()
+    time.sleep(0.6)  # the frozen heartbeat goes stale
+    res = _campaign(contracts, None, fleet_dir=fl, lease_ttl=0.5,
+                    worker_id="w1").run()
+    merged = _merge_fleet(res, fl)
+    cov = merged.get("coverage") or {}
+    kinds = [e.get("kind") for e in res.backend_events]
+    issues = sorted(i["contract"]
+                    for i in merged.get("issues_detail", []))
+    cell = {"frozen_unit": unit.uid if unit else None,
+            "reclaims": kinds.count("lease_reclaimed"),
+            "issues": issues,
+            "coverage": {k: cov.get(k) for k in
+                         ("analyzed", "lost", "unaccounted", "full")}}
+    cell["ok"] = (unit is not None
+                  and kinds.count("lease_reclaimed") >= 1
+                  and cov.get("full") is True
+                  and issues == baseline)
+    return cell
+
+
+def _cell_serve(point: str, d: str, contracts,
+                baseline: List[str]) -> Dict:
+    from mythril_tpu.obs import metrics as obs_metrics
+    from mythril_tpu.serve import AnalysisDaemon, ServeOptions
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import serve_client
+
+    def counter(name: str) -> float:
+        return obs_metrics.REGISTRY.counter(name).value
+
+    opts = ServeOptions(batch_size=2, lanes_per_contract=8,
+                        max_steps=64, transaction_count=1,
+                        modules=["AccidentallyKillable"],
+                        limits_profile="test",
+                        batch_timeout=_BATCH_TIMEOUT,
+                        worker_isolation="on")
+    restarts0 = counter("engine_worker_restarts_total")
+    with _fault_env(point, d):
+        dm = AnalysisDaemon(opts, data_dir=os.path.join(d, "sd"),
+                            port=0)
+        dm.start()
+        url = f"http://127.0.0.1:{dm.port}"
+        try:
+            snap = serve_client.submit(url, contracts, tenant="chaos")
+            final = serve_client.get_result(url, snap["id"], wait=600.0)
+            health = serve_client.healthz(url)
+        finally:
+            dm.shutdown("chaos-cell")
+    results = final["results"]
+    by_name: Dict[str, int] = {}
+    for r in results:
+        by_name[r["name"]] = by_name.get(r["name"], 0) + 1
+    issues = sorted(i["contract"] for r in results
+                    for i in (r.get("issues") or []))
+    restarts = counter("engine_worker_restarts_total") - restarts0
+    cell = {"issues": issues, "completed": final["completed"],
+            "state": final["state"],
+            "worker_restarts": restarts,
+            "health_state": health.get("state"),
+            "statuses": sorted({r["status"] for r in results})}
+    cell["ok"] = (final["state"] == "done"
+                  and final["completed"] == N
+                  and all(n == 1 for n in by_name.values())
+                  and issues == baseline
+                  and restarts >= 1
+                  and all(r["status"] == "ok" for r in results))
+    return cell
+
+
+def run_cell(mode: str, point: str, contracts,
+             baseline: List[str]) -> Dict:
+    with tempfile.TemporaryDirectory() as d:
+        if point in _WORKER_POINTS:
+            if mode in ("batch", "pipelined"):
+                return _cell_batch(mode, point, d, contracts, baseline)
+            if mode == "fleet":
+                return _cell_fleet_worker(point, d, contracts, baseline)
+            if mode == "serve":
+                return _cell_serve(point, d, contracts, baseline)
+        if mode == "fleet" and point == "torn-ledger":
+            return _cell_torn_ledger(d, contracts, baseline)
+        if mode == "fleet" and point == "frozen-heartbeat":
+            return _cell_frozen_heartbeat(d, contracts, baseline)
+        raise ValueError(f"cell {mode}:{point} is not in the matrix")
+
+
+def run_matrix(cells: List[Tuple[str, str]]) -> Dict:
+    """Run the given (mode, point) cells against one shared baseline.
+    Importable — the soak's ``chaos`` leg calls this with the reduced
+    matrix."""
+    contracts = _corpus()
+    base = _campaign(contracts, None, worker_isolation="off").run()
+    baseline = _issues(base)
+    out: Dict = {"baseline": baseline, "cells": {}, "ok": True}
+    if not baseline:
+        out["ok"] = False  # a no-issue baseline asserts nothing
+        return out
+    for mode, point in cells:
+        key = f"{mode}:{point}"
+        try:
+            cell = run_cell(mode, point, contracts, baseline)
+        except Exception as e:  # noqa: BLE001 — a cell must not kill the matrix
+            cell = {"ok": False,
+                    "error": f"{type(e).__name__}: {str(e)[:300]}"}
+        out["cells"][key] = cell
+        out["ok"] &= bool(cell.get("ok"))
+        print(f"chaos {key}: {'ok' if cell.get('ok') else 'FAIL'}",
+              file=sys.stderr, flush=True)
+    return out
+
+
+def parse_cells(text: Optional[str]) -> List[Tuple[str, str]]:
+    if not text:
+        return [(m, p) for m, pts in MATRIX.items() for p in pts]
+    cells = []
+    for item in text.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        mode, _, point = item.partition(":")
+        if mode not in MATRIX or point not in MATRIX[mode]:
+            raise ValueError(
+                f"unknown cell {item!r}; modes {tuple(MATRIX)} with "
+                f"points per mode {MATRIX}")
+        cells.append((mode, point))
+    return cells
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cells", metavar="MODE:POINT,...", default=None,
+                    help="subset of the matrix, e.g. "
+                         "'batch:segv-mid-superstep,fleet:torn-ledger' "
+                         "(default: every applicable cell)")
+    args = ap.parse_args()
+    try:
+        cells = parse_cells(args.cells)
+    except ValueError as e:
+        ap.error(str(e))
+    out = run_matrix(cells)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

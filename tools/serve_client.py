@@ -18,13 +18,47 @@ Importable pieces (used by tests/test_serve.py and the soak):
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import os
+import random
 import sys
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+#: cap on one retry sleep — backoff doubles per attempt but a client
+#: must never nap minutes between probes of a restarting daemon
+MAX_BACKOFF_S = 15.0
+
+
+def with_retry(fn: Callable[[], Dict], retries: int = 0,
+               backoff: float = 0.5) -> Dict:
+    """Run ``fn`` with bounded retry on the failures a daemon RESTART
+    produces: connection refused/reset (the process is down), torn
+    responses (it died mid-reply), and HTTP 503 (it is draining —
+    ``Retry-After`` says come back). Exponential backoff with jitter
+    (``backoff * 2^attempt * uniform(0.5, 1.5)``, capped) so N clients
+    don't stampede the moment the daemon returns. ``retries=0`` is
+    exactly the old raise-through behavior; anything else (400/404/429,
+    ValueError) still raises immediately — those are the CALLER's
+    bugs, not the daemon's lifecycle."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except urllib.error.HTTPError as e:
+            if e.code != 503 or attempt >= retries:
+                raise
+        except (urllib.error.URLError, ConnectionError,
+                http.client.HTTPException, TimeoutError):
+            if attempt >= retries:
+                raise
+        delay = min(MAX_BACKOFF_S,
+                    backoff * (2 ** attempt) * (0.5 + random.random()))
+        time.sleep(delay)
+        attempt += 1
 
 
 def _post(url: str, doc: Dict, timeout: float = 30.0) -> Dict:
@@ -39,10 +73,14 @@ def submit(base_url: str, contracts: Sequence[Tuple[str, bytes]],
            tenant: str = "default", priority: int = 0,
            deadline_sec: Optional[float] = None,
            options: Optional[Dict] = None,
-           timeout: float = 30.0) -> Dict:
+           timeout: float = 30.0, retries: int = 0,
+           backoff: float = 0.5) -> Dict:
     """POST /v1/submit. Returns the submission snapshot (id +
     already-deduped results). Raises ``urllib.error.HTTPError`` on
-    429 (queue full) / 503 (draining)."""
+    429 (queue full) / 503 (draining) once ``retries`` connection/503
+    attempts are exhausted. NOTE a retried submit may re-admit work an
+    earlier torn reply already queued — the dedupe store makes that
+    idempotent (the resubmission serves from dedupe)."""
     doc: Dict = {
         "contracts": [{"name": n, "code": c.hex()}
                       for n, c in contracts],
@@ -52,20 +90,27 @@ def submit(base_url: str, contracts: Sequence[Tuple[str, bytes]],
         doc["deadline_sec"] = deadline_sec
     if options:
         doc["options"] = options
-    return _post(base_url.rstrip("/") + "/v1/submit", doc, timeout)
+    return with_retry(
+        lambda: _post(base_url.rstrip("/") + "/v1/submit", doc, timeout),
+        retries=retries, backoff=backoff)
 
 
 def get_result(base_url: str, sid: str, wait: float = 0.0,
-               timeout: Optional[float] = None) -> Dict:
+               timeout: Optional[float] = None, retries: int = 0,
+               backoff: float = 0.5) -> Dict:
     """GET /v1/result/<id>, long-polling ``wait`` seconds for
     completion."""
     url = f"{base_url.rstrip('/')}/v1/result/{sid}"
     if wait:
         url += f"?wait={wait:g}"
-    with urllib.request.urlopen(
-            url, timeout=timeout if timeout is not None
-            else max(wait + 10.0, 30.0)) as resp:
-        return json.load(resp)
+
+    def go() -> Dict:
+        with urllib.request.urlopen(
+                url, timeout=timeout if timeout is not None
+                else max(wait + 10.0, 30.0)) as resp:
+            return json.load(resp)
+
+    return with_retry(go, retries=retries, backoff=backoff)
 
 
 def stream_results(base_url: str, sid: str,
@@ -149,6 +194,15 @@ def main() -> int:
                          "long-poll)")
     ap.add_argument("--wait", type=float, default=300.0,
                     help="long-poll budget in seconds (default 300)")
+    ap.add_argument("--retries", type=int, default=3, metavar="N",
+                    help="bounded retry on connection errors and 503 "
+                         "(a draining/restarting daemon), with "
+                         "exponential backoff + jitter (default 3; "
+                         "0 = fail fast)")
+    ap.add_argument("--backoff", type=float, default=0.5, metavar="SEC",
+                    help="base retry backoff; attempt k sleeps "
+                         "base*2^k with jitter, capped at "
+                         f"{MAX_BACKOFF_S:.0f}s (default 0.5)")
     args = ap.parse_args()
 
     contracts = load_contracts(args)
@@ -160,10 +214,15 @@ def main() -> int:
     try:
         snap = submit(args.url, contracts, tenant=args.tenant,
                       priority=args.priority,
-                      deadline_sec=args.deadline, options=options)
+                      deadline_sec=args.deadline, options=options,
+                      retries=args.retries, backoff=args.backoff)
     except urllib.error.HTTPError as e:
         print(f"error: submit failed: HTTP {e.code} "
               f"{e.read().decode()[:300]}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, ConnectionError) as e:
+        print(f"error: submit failed after {args.retries} retries: {e}",
+              file=sys.stderr)
         return 1
     sid = snap["id"]
     t_submit = time.monotonic() - t0
@@ -186,7 +245,8 @@ def main() -> int:
                      if rec.get("served_from") else "")
                   + ")", file=sys.stderr)
     else:
-        snap = get_result(args.url, sid, wait=args.wait)
+        snap = get_result(args.url, sid, wait=args.wait,
+                          retries=args.retries, backoff=args.backoff)
         results = snap["results"]
         lat = [time.monotonic() - t0] * len(results)
         if snap["state"] != "done":

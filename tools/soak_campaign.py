@@ -58,6 +58,13 @@ Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
      kill, and the final issue set must be byte-identical to a
      store-disabled baseline — no verdict divergence, exactly-once
      durability for solver work like for everything else.
+ 11. chaos — a reduced tools/chaos_campaign.py fault matrix on CPU
+     (docs/resilience.md "Process isolation & supervision"): a real
+     SIGSEGV into the engine-worker subprocess mid-superstep (batch
+     mode) and a torn fleet-ledger result file, each asserting issue
+     parity with an uninjected baseline, exactly-once accounting, and
+     the recovery events on record. The full matrix is the
+     pre-release gate; this leg keeps the boundary honest per-change.
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
@@ -115,7 +122,7 @@ SAFE = assemble(1, 0, "SSTORE", "STOP")
 N = 6  # even indices killable -> expected issues c000/c002/c004
 
 LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry",
-        "pipeline", "fleet", "serve", "solver_store")
+        "pipeline", "fleet", "serve", "solver_store", "chaos")
 
 
 def write_corpus(d: str) -> str:
@@ -565,6 +572,21 @@ def main() -> int:
                    and issues == base_issues
                    and sorted(i["contract"] for i in r10a.issues)
                    == base_issues)
+
+        if "chaos" in want:
+            # leg 11: the reduced chaos matrix (one engine-worker
+            # SIGSEGV cell, one torn-ledger cell) — the subprocess
+            # isolation boundary and the ledger's torn-result recovery
+            # exercised end to end with parity + exactly-once asserted
+            # inside the tool itself
+            sys.path.insert(0, os.path.join(ROOT, "tools"))
+            import chaos_campaign
+
+            out = chaos_campaign.run_matrix(
+                [("batch", "segv-mid-superstep"),
+                 ("fleet", "torn-ledger")])
+            legs["chaos"] = out
+            ok &= bool(out.get("ok"))
 
     print(json.dumps({"ok": bool(ok), "legs": legs}))
     return 0 if ok else 1
