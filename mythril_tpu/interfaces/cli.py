@@ -355,6 +355,54 @@ def create_parser() -> argparse.ArgumentParser:
     sv.add_argument("--max-queue", type=int, default=4096, metavar="N",
                     help="admission queue depth bound; overflow gets "
                          "HTTP 429 (default 4096)")
+    sv.add_argument("--tenant-rate", type=float, default=None,
+                    metavar="R",
+                    help="default per-tenant admission rate: a token "
+                         "bucket of R fresh contracts/sec per tenant "
+                         "(dedupe hits are free); breach gets HTTP "
+                         "429 with Retry-After (default: unlimited)")
+    sv.add_argument("--tenant-burst", type=int, default=None,
+                    metavar="N",
+                    help="default token-bucket capacity (default: "
+                         "max(8, 2*rate))")
+    sv.add_argument("--tenant-max-inflight", type=int, default=None,
+                    metavar="N",
+                    help="default per-tenant cap on queued+running "
+                         "entries (default: unlimited)")
+    sv.add_argument("--quota", action="append", default=None,
+                    metavar="TENANT=RATE[:BURST[:INFLIGHT]]",
+                    help="per-tenant quota override (repeatable); "
+                         "blank fields mean unlimited, e.g. "
+                         "--quota scanner=2:8:4 --quota ops=::64")
+    sv.add_argument("--shed-depth-hi", type=float, default=0.85,
+                    metavar="FRAC",
+                    help="enter load shedding when queue depth "
+                         "reaches FRAC of --max-queue (default 0.85); "
+                         "low-priority submissions then get verdict-"
+                         "store-only answers until pressure clears")
+    sv.add_argument("--shed-age-hi", type=float, default=30.0,
+                    metavar="SEC",
+                    help="enter load shedding when the oldest queued "
+                         "entry is SEC old (default 30)")
+    sv.add_argument("--shed-priority-max", type=int, default=0,
+                    metavar="P",
+                    help="submissions with priority <= P are the "
+                         "sheddable class (default 0 — the default "
+                         "priority; pass a higher priority to keep a "
+                         "lane under overload)")
+    sv.add_argument("--no-shed", action="store_true",
+                    help="disable the load-shedding ladder (overflow "
+                         "then only ever 429s)")
+    sv.add_argument("--follow", metavar="RPC_URI",
+                    help="chain-head follower: poll eth_blockNumber "
+                         "on RPC_URI, ingest newly deployed contracts "
+                         "as the standing lowest-priority tenant "
+                         "'follower' (shed first under overload); "
+                         "resumes from a durable cursor in --data-dir")
+    sv.add_argument("--follow-poll", type=float, default=2.0,
+                    metavar="SEC",
+                    help="follower poll cadence at the chain head "
+                         "(default 2.0)")
     sv.add_argument("--drain-timeout", type=float, default=30.0,
                     metavar="SEC",
                     help="SIGTERM drain budget: how long the in-flight "
@@ -863,13 +911,36 @@ def exec_serve(args) -> int:
     from ..obs import metrics as obs_metrics
     from ..obs import trace as obs_trace
     from ..resilience import parse_ladder
-    from ..serve import AnalysisDaemon, ServeOptions
+    from ..serve import (AnalysisDaemon, ServeOptions, ShedPolicy,
+                         TenantQuota)
 
     try:
         oom_ladder = parse_ladder(args.oom_ladder)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         raise SystemExit(2)
+    default_quota = None
+    if (args.tenant_rate is not None or args.tenant_burst is not None
+            or args.tenant_max_inflight is not None):
+        default_quota = TenantQuota(
+            rate=args.tenant_rate, burst=args.tenant_burst,
+            max_inflight=args.tenant_max_inflight)
+    quotas = {}
+    for spec in args.quota or []:
+        tenant, sep, rest = spec.partition("=")
+        if not sep or not tenant:
+            print(f"error: bad --quota {spec!r}; want "
+                  "TENANT=RATE[:BURST[:INFLIGHT]]", file=sys.stderr)
+            raise SystemExit(2)
+        try:
+            quotas[tenant] = TenantQuota.parse(rest)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            raise SystemExit(2)
+    shed = (None if args.no_shed
+            else ShedPolicy(depth_hi=args.shed_depth_hi,
+                            age_hi=args.shed_age_hi,
+                            priority_max=args.shed_priority_max))
     if args.trace:
         obs_trace.configure(args.trace)
     opts = ServeOptions(
@@ -895,7 +966,9 @@ def exec_serve(args) -> int:
         dedupe=args.dedupe, max_queue=args.max_queue,
         drain_timeout=args.drain_timeout, fleet_dir=args.fleet,
         solver_store=(None if args.no_solver_store
-                      else (args.solver_store or "auto")))
+                      else (args.solver_store or "auto")),
+        quotas=quotas or None, default_quota=default_quota, shed=shed,
+        follow_uri=args.follow, follow_poll=args.follow_poll)
     daemon.install_signal_handlers()
     try:
         daemon.start()

@@ -51,6 +51,29 @@ def _prom_name(name: str) -> str:
     return n
 
 
+def _esc_label(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", " "))
+
+
+def label_key(name: str, labels: Optional[Dict]) -> str:
+    """Registry key for one labeled series: the base name plus a
+    canonical (sorted, escaped) Prometheus label block. Two call sites
+    with the same labels in any order share one series."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{_esc_label(labels[k])}"'
+                     for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def _split_labels(name: str):
+    """``(base, label_block)`` from a registry key; the block keeps its
+    braces (``'{reason="depth"}'``) or is empty."""
+    base, sep, rest = name.partition("{")
+    return base, (sep + rest if sep else "")
+
+
 class Counter:
     """Monotonically increasing value."""
 
@@ -162,11 +185,17 @@ class MetricsRegistry:
                     f"{type(m).__name__}, requested {cls.__name__}")
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get(name, Counter, help=help)
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict] = None) -> Counter:
+        """``labels`` names one series of a labeled family
+        (``counter("serve_shed_total", labels={"reason": "depth"})``);
+        the Prometheus rendering groups the family under one
+        HELP/TYPE block."""
+        return self._get(label_key(name, labels), Counter, help=help)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get(name, Gauge, help=help)
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict] = None) -> Gauge:
+        return self._get(label_key(name, labels), Gauge, help=help)
 
     def histogram(self, name: str, help: str = "",
                   buckets: Optional[Sequence[float]] = None) -> Histogram:
@@ -199,22 +228,29 @@ class MetricsRegistry:
         with self._lock:
             items = list(self._metrics.items())
         lines: List[str] = []
+        # HELP/TYPE are per FAMILY: labeled series of one base name
+        # share a single header block (Prometheus exposition contract)
+        headed: set = set()
+
+        def head(pn: str, kind: str, help_text: str) -> None:
+            if pn in headed:
+                return
+            headed.add(pn)
+            if help_text:
+                lines.append(f"# HELP {pn} {help_text}")
+            lines.append(f"# TYPE {pn} {kind}")
+
         for name, m in items:
-            pn = _prom_name(prefix + name)
+            base, labels = _split_labels(name)
+            pn = _prom_name(prefix + base)
             if isinstance(m, Counter):
-                if m.help:
-                    lines.append(f"# HELP {pn} {m.help}")
-                lines.append(f"# TYPE {pn} counter")
-                lines.append(f"{pn} {m.value:g}")
+                head(pn, "counter", m.help)
+                lines.append(f"{pn}{labels} {m.value:g}")
             elif isinstance(m, Gauge):
-                if m.help:
-                    lines.append(f"# HELP {pn} {m.help}")
-                lines.append(f"# TYPE {pn} gauge")
-                lines.append(f"{pn} {m.value:g}")
+                head(pn, "gauge", m.help)
+                lines.append(f"{pn}{labels} {m.value:g}")
             elif isinstance(m, Histogram):
-                if m.help:
-                    lines.append(f"# HELP {pn} {m.help}")
-                lines.append(f"# TYPE {pn} histogram")
+                head(pn, "histogram", m.help)
                 d = m.as_dict()
                 for le, n in d["buckets"].items():
                     lines.append(f'{pn}_bucket{{le="{le}"}} {n}')
@@ -247,4 +283,4 @@ def get_registry() -> MetricsRegistry:
 
 
 __all__ = ["SCHEMA", "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry", "REGISTRY", "get_registry"]
+           "MetricsRegistry", "REGISTRY", "get_registry", "label_key"]

@@ -18,14 +18,20 @@ big per-request costs across every request it will ever take:
 The pieces:
 
 - :mod:`serve.store` — durable per-contract verdict store (the first
-  slice of ROADMAP's cross-campaign verdict store);
+  slice of ROADMAP's cross-campaign verdict store), first-wins across
+  N replica daemons sharing one ``--data-dir``;
 - :mod:`serve.queue` — admission queue: dedupe, per-tenant priority +
-  deadline ordering, deadline eviction, bounded depth;
+  deadline ordering, deadline eviction, bounded depth, per-tenant
+  token-bucket quotas + SLO accounting, and the load-shedding ladder
+  (overload degrades low-priority submissions to store-only answers);
 - :mod:`serve.scheduler` — drains the queue into resident campaigns
   (or a fleet FEED ledger fronting remote workers, docs/fleet.md);
 - :mod:`serve.http` — thin stdlib HTTP surface (`POST /v1/submit`,
   long-poll / chunked-streaming `GET /v1/result/<id>`, `/healthz`,
   Prometheus `/metrics`);
+- :mod:`serve.follower` — chain-head follower (`serve --follow URI`):
+  ingests newly deployed contracts as a standing lowest-priority
+  tenant, shed first under overload;
 - :mod:`serve.daemon` — lifecycle: wiring, signal handling, graceful
   drain (SIGTERM finishes the in-flight batch, persists its verdicts,
   rejects new submissions with 503, then exits — a restart serves the
@@ -37,11 +43,14 @@ backend-free front door.
 """
 
 from .daemon import AnalysisDaemon, ServeOptions
+from .follower import FOLLOWER_PRIORITY, ChainFollower
 from .queue import (AdmissionQueue, Entry, QueueClosed, QueueFull,
-                    Submission)
+                    QuotaExceeded, ShedPolicy, Submission, TenantQuota)
 from .scheduler import Scheduler
 from .store import ResultsStore, bytecode_hash, config_hash
 
-__all__ = ["AdmissionQueue", "AnalysisDaemon", "Entry", "QueueClosed",
-           "QueueFull", "ResultsStore", "Scheduler", "ServeOptions",
-           "Submission", "bytecode_hash", "config_hash"]
+__all__ = ["AdmissionQueue", "AnalysisDaemon", "ChainFollower",
+           "Entry", "FOLLOWER_PRIORITY", "QueueClosed", "QueueFull",
+           "QuotaExceeded", "ResultsStore", "Scheduler",
+           "ServeOptions", "ShedPolicy", "Submission", "TenantQuota",
+           "bytecode_hash", "config_hash"]

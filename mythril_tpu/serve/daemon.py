@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from .http import ServeHTTPServer
-from .queue import AdmissionQueue
+from .queue import AdmissionQueue, ShedPolicy, TenantQuota
 from .scheduler import Scheduler
 from .store import ResultsStore
 
@@ -119,11 +119,22 @@ class AnalysisDaemon:
                  drain_timeout: float = 30.0,
                  fleet_dir: Optional[str] = None,
                  campaign_factory=None,
-                 solver_store: Optional[str] = "auto"):
+                 solver_store: Optional[str] = "auto",
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 default_quota: Optional[TenantQuota] = None,
+                 shed: Optional[ShedPolicy] = "auto",
+                 follow_uri: Optional[str] = None,
+                 follow_poll: float = 2.0):
         self.options = options or ServeOptions()
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self.store = ResultsStore(os.path.join(data_dir, "store"))
+        # overload protection defaults ON (docs/serving.md "Overload &
+        # multi-replica serving"): the default thresholds only engage
+        # when the queue is nearly full or entries have sat for tens
+        # of seconds — an unloaded daemon never sheds. None disables.
+        if shed == "auto":
+            shed = ShedPolicy()
         # per-QUERY solver verdict store (docs/solver.md) beside the
         # per-CONTRACT dedupe store: the daemon's solver work survives
         # restarts and is shared with any fleet workers it fronts.
@@ -133,7 +144,11 @@ class AnalysisDaemon:
         self.solver_store = solver_store
         self.queue = AdmissionQueue(
             store=self.store, dedupe=dedupe, max_depth=max_queue,
-            config_fn=self.options.effective)
+            config_fn=self.options.effective, quotas=quotas,
+            default_quota=default_quota, shed=shed)
+        self.follow_uri = follow_uri
+        self.follow_poll = float(follow_poll)
+        self.follower = None
         self.scheduler = Scheduler(
             self.queue, store=self.store,
             batch_size=self.options.batch_size,
@@ -161,10 +176,14 @@ class AnalysisDaemon:
         from ..smt import portfolio as smt_portfolio
 
         vstore = smt_portfolio.get_store()
+        qstats = self.queue.stats()
         doc = {
             "ok": True,
             "state": self.state,
-            "queue_depth": self.queue.depth(),
+            "queue_depth": qstats["queue_depth"],
+            "oldest_entry_age_sec": qstats["oldest_entry_age_sec"],
+            "shed_state": qstats["shed_state"],
+            "tenants": qstats["tenants"],
             "batches_run": self.scheduler.batches_run,
             "fleet_units_pending": self.scheduler.pending_fleet_units(),
             "store_verdicts": self.store.count(),
@@ -184,6 +203,8 @@ class AnalysisDaemon:
         degraded = self.scheduler.degraded_configs()
         if degraded:
             doc["degraded_configs"] = degraded
+        if self.follower is not None:
+            doc["follower"] = self.follower.status()
         return doc
 
     @property
@@ -214,6 +235,14 @@ class AnalysisDaemon:
             name="serve-http")
         self._http_thread.start()
         self.state = "serving"
+        if self.follow_uri:
+            from ..utils.loader import rpc_client_from_uri
+            from .follower import ChainFollower
+
+            self.follower = ChainFollower(
+                self, rpc_client_from_uri(self.follow_uri),
+                poll=self.follow_poll)
+            self.follower.start()
         obs_trace.event("serve_started", host=self.host, port=self.port,
                         data_dir=self.data_dir)
         log.info("serving on %s:%d (data dir %s)", self.host, self.port,
@@ -230,6 +259,11 @@ class AnalysisDaemon:
         obs_trace.event("serve_draining", reason=reason)
         log.info("draining (%s): rejecting new submissions, finishing "
                  "the in-flight batch", reason)
+        if self.follower is not None:
+            # the follower stops BEFORE the queue closes, so its last
+            # block either submitted fully or will be retried from the
+            # durable cursor on restart — never half-ingested
+            self.follower.stop()
         self.queue.close()
         self.scheduler.request_stop()
         if not self.scheduler.join(self.drain_timeout):

@@ -1,0 +1,249 @@
+"""Chain-head follower: a standing lowest-priority background tenant.
+
+``serve --follow RPC_URI`` (docs/serving.md "Overload & multi-replica
+serving") runs this loop beside the scheduler: poll the node's
+``eth_blockNumber``, walk every new block's transactions for contract
+creations (``to == null`` → ``eth_getTransactionReceipt`` →
+``contractAddress``), fetch each new contract's runtime bytecode with
+``eth_getCode``, and submit it through the normal admission queue as
+``tenant="follower"`` at :data:`FOLLOWER_PRIORITY` — the lowest
+priority in the system, BY DESIGN the first workload shed under
+overload and the last to claim a lane. The payoff is the ROADMAP
+chain-follower story: by the time a user asks about a contract, its
+verdict is usually precomputed (mainnet's proxy/clone dominance means
+the marginal new contract is a canonical-hash dedupe hit anyway; the
+follower turns the rest into warm store entries during quiet periods).
+
+Contracts:
+
+- **durable cursor** — the last fully-ingested block number persists
+  to ``<data-dir>/follower_cursor.json`` (repo-wide ``durable_write``)
+  after each block, so a restarted daemon resumes where it left off
+  instead of re-walking or skipping the gap. A FRESH follower starts
+  at the current head (no genesis backfill);
+- **bounded backoff** — RPC failures (node down, malformed replies)
+  double a capped backoff and tick
+  ``serve_follower_rpc_errors_total``; the loop never dies, never
+  spins, and recovers to the poll cadence on the first success;
+- **backpressure, not pressure** — a full queue or a spent quota makes
+  the follower WAIT (cursor unmoved, block retried); while the daemon
+  sheds, follower submissions resolve as store-hits/typed-shed
+  answers like any other low-priority tenant — the follower is the
+  standing proof-load for the quota/shed machinery;
+- **lag visibility** — ``serve_follower_lag_blocks`` (head − cursor)
+  and ``serve_follower_ingested_total`` are live in ``/metrics``;
+  ``/healthz`` carries ``follower: {cursor, head, lag, ingested, ...}``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..utils.checkpoint import durable_write
+from .queue import QueueClosed, QueueFull, QuotaExceeded
+
+log = logging.getLogger(__name__)
+
+#: the follower's fixed priority: below every interactive submission
+#: (default 0), so it is shed first and scheduled last
+FOLLOWER_PRIORITY = -100
+
+#: cursor-file schema (readers reject newer-than-known)
+CURSOR_SCHEMA = 1
+
+
+class ChainFollower:
+    """Background ingestion loop over the existing JSON-RPC client
+    (``utils/loader.HttpRpcClient`` — anything with ``eth_blockNumber``
+    / ``eth_getBlockByNumber`` / ``eth_getTransactionReceipt`` /
+    ``eth_getCode`` duck-types)."""
+
+    def __init__(self, daemon, client, poll: float = 2.0,
+                 cursor_path: Optional[str] = None,
+                 tenant: str = "follower",
+                 priority: int = FOLLOWER_PRIORITY,
+                 max_backoff: float = 60.0,
+                 max_blocks_per_poll: int = 16):
+        self.daemon = daemon
+        self.client = client
+        self.poll = max(0.05, float(poll))
+        self.cursor_path = cursor_path or os.path.join(
+            daemon.data_dir, "follower_cursor.json")
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.max_backoff = float(max_backoff)
+        self.max_blocks_per_poll = max(1, int(max_blocks_per_poll))
+        self.cursor: Optional[int] = self._load_cursor()
+        self.head: Optional[int] = None
+        self.ingested = 0
+        self.rpc_errors = 0
+        self._backoff = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._reg = obs_metrics.REGISTRY
+
+    # --- cursor durability ----------------------------------------------
+    def _load_cursor(self) -> Optional[int]:
+        try:
+            with open(self.cursor_path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if (not isinstance(doc, dict)
+                or int(doc.get("schema", 0) or 0) > CURSOR_SCHEMA
+                or not isinstance(doc.get("block"), int)):
+            return None
+        return doc["block"]
+
+    def _save_cursor(self) -> None:
+        durable_write(
+            self.cursor_path,
+            json.dumps({"schema": CURSOR_SCHEMA, "block": self.cursor,
+                        "t": round(time.time(), 3)}).encode(),
+            rotate=False)
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-follower")
+        self._thread.start()
+        obs_trace.event("follower_started", cursor=self.cursor,
+                        tenant=self.tenant, priority=self.priority)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def status(self) -> Dict:
+        lag = (max(0, self.head - self.cursor)
+               if self.head is not None and self.cursor is not None
+               else None)
+        return {"cursor": self.cursor, "head": self.head, "lag": lag,
+                "ingested": self.ingested,
+                "rpc_errors": self.rpc_errors,
+                "backoff_sec": round(self._backoff, 3)}
+
+    # --- the loop -------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                delay = self._tick()
+                self._backoff = 0.0
+            except Exception as e:  # noqa: BLE001 — the loop may not die
+                self.rpc_errors += 1
+                self._reg.counter(
+                    "serve_follower_rpc_errors_total",
+                    help="follower poll/ingest failures (backed "
+                         "off, retried)").inc()
+                self._backoff = min(self.max_backoff,
+                                    max(self.poll, self._backoff * 2))
+                obs_trace.event("follower_rpc_error",
+                                detail=f"{type(e).__name__}: "
+                                       f"{str(e)[:200]}",
+                                backoff=round(self._backoff, 3))
+                log.warning("follower: %s: %s (backing off %.1fs)",
+                            type(e).__name__, str(e)[:200],
+                            self._backoff)
+                delay = self._backoff
+            self._stop.wait(delay)
+        obs_trace.event("follower_stopped", cursor=self.cursor,
+                        ingested=self.ingested)
+
+    def _tick(self) -> float:
+        """One poll: advance the cursor toward the head by up to
+        ``max_blocks_per_poll`` blocks. Returns how long to sleep
+        before the next tick (0 while catching up a backlog)."""
+        self.head = int(self.client.eth_blockNumber(), 16)
+        if self.cursor is None:
+            # fresh follower: start AT the head — ingest what deploys
+            # from now on, don't backfill the whole chain
+            self.cursor = self.head
+            self._save_cursor()
+        self._lag_gauge()
+        done = 0
+        while (self.cursor < self.head
+               and done < self.max_blocks_per_poll
+               and not self._stop.is_set()):
+            if not self._ingest_block(self.cursor + 1):
+                return self.poll     # backpressure: retry this block
+            self.cursor += 1
+            self._save_cursor()
+            done += 1
+            self._reg.counter(
+                "serve_follower_blocks_total",
+                help="chain blocks the follower has walked").inc()
+        self._lag_gauge()
+        return 0.0 if self.cursor < self.head else self.poll
+
+    def _lag_gauge(self) -> None:
+        if self.head is not None and self.cursor is not None:
+            self._reg.gauge(
+                "serve_follower_lag_blocks",
+                help="blocks between the chain head and the "
+                     "follower's durable cursor").set(
+                max(0, self.head - self.cursor))
+
+    def _new_contracts(self, n: int) -> List[Tuple[str, bytes]]:
+        """``(address, runtime_bytecode)`` for every contract created
+        in block ``n``. Creations without a receipt/address or with
+        empty runtime code (selfdestructed in the same block, EOA
+        funding) are skipped."""
+        blk = self.client.eth_getBlockByNumber(hex(n), True)
+        out: List[Tuple[str, bytes]] = []
+        for tx in (blk or {}).get("transactions") or []:
+            if not isinstance(tx, dict) or tx.get("to"):
+                continue
+            txh = tx.get("hash")
+            if not txh:
+                continue
+            rcpt = self.client.eth_getTransactionReceipt(txh) or {}
+            addr = rcpt.get("contractAddress")
+            if not addr:
+                continue
+            code = self.client.eth_getCode(addr)
+            try:
+                raw = bytes.fromhex(str(code).removeprefix("0x"))
+            except ValueError:
+                continue
+            if raw:
+                out.append((str(addr), raw))
+        return out
+
+    def _ingest_block(self, n: int) -> bool:
+        """Submit block ``n``'s new contracts. Returns False on
+        BACKPRESSURE (queue full / quota spent) so the caller retries
+        the same block later — the cursor only advances past blocks
+        whose contracts were actually answered for."""
+        contracts = self._new_contracts(n)
+        if not contracts:
+            return True
+        try:
+            self.daemon.queue.submit(contracts, tenant=self.tenant,
+                                     priority=self.priority)
+        except (QueueFull, QuotaExceeded):
+            self._reg.counter(
+                "serve_follower_backpressure_total",
+                help="follower submissions deferred by a full queue "
+                     "or spent quota").inc()
+            return False
+        except QueueClosed:
+            self._stop.set()
+            return False
+        self.ingested += len(contracts)
+        self._reg.counter(
+            "serve_follower_ingested_total",
+            help="newly deployed contracts submitted by the "
+                 "follower").inc(len(contracts))
+        obs_trace.event("follower_ingest", block=n, n=len(contracts))
+        return True
+
+
+__all__ = ["CURSOR_SCHEMA", "ChainFollower", "FOLLOWER_PRIORITY"]

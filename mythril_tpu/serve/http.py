@@ -7,8 +7,10 @@ Endpoints (JSON in/out, no dependencies beyond ``http.server``):
   ``priority`` (int, higher first), ``deadline_sec`` (float) and
   ``options`` (per-request analysis overrides, see
   ``ServeOptions.OVERRIDABLE``). Returns 202 with the submission id
-  (dedupe-served entries are already in ``results``), 429 when the
-  queue is full, 503 while draining, 400 on a malformed body.
+  (dedupe-served entries are already in ``results``; shed-served
+  entries likewise, while the daemon is overloaded), 429 when the
+  queue is full or the tenant's quota is spent (``Retry-After`` set
+  either way), 503 while draining, 400 on a malformed body.
 - ``GET /v1/result/<id>[?wait=SEC]`` — submission snapshot; ``wait``
   long-polls until NEW results commit (or the timeout lapses).
 - ``GET /v1/result/<id>?stream=1`` — chunked transfer: one JSON line
@@ -31,7 +33,7 @@ from typing import Dict, Optional, Tuple
 
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
-from .queue import QueueClosed, QueueFull
+from .queue import QueueClosed, QueueFull, QuotaExceeded
 
 #: cap on submission body size: serve is an analysis API, not an
 #: artifact store; 64 MiB covers thousands of max-size contracts
@@ -142,6 +144,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(503, {"error": "daemon is draining; resubmit "
                                       "to a live instance"},
                        {"Retry-After": "5"})
+            return
+        except QuotaExceeded as e:
+            # per-tenant quota breach: Retry-After tells the client
+            # when its token bucket will cover the submission
+            import math
+
+            self._json(429, {"error": str(e)},
+                       {"Retry-After": str(math.ceil(e.retry_after))})
             return
         except QueueFull as e:
             self._json(429, {"error": str(e)}, {"Retry-After": "1"})

@@ -11,7 +11,20 @@ contract becomes one :class:`Entry`; admission runs, in order:
    batch commits, every follower resolves from the same verdict
    (``served_from="dedupe-inflight"``) — N concurrent submitters of one
    proxy bytecode cost one analysis;
-3. **admission** — the entry joins the queue, ordered by
+3. **quota** — per-tenant admission control (docs/serving.md "Overload
+   & multi-replica serving"): a token-bucket rate (tokens buy FRESH
+   entries — dedupe hits are free) and a max-in-flight cap per tenant.
+   A breach raises :class:`QuotaExceeded` (HTTP 429 with a computed
+   ``Retry-After``). Quotas are per tenant, so one throttled tenant
+   can never starve the others;
+4. **load shedding** — under overload (queue depth or oldest-entry age
+   past :class:`ShedPolicy` thresholds) LOW-priority submissions stop
+   reaching the queue at all: every contract is answered from the
+   verdict store (``served_from="shed-store"``) or resolved with a
+   typed ``status="shed"`` result — degraded, never dropped, never
+   buffered. Recovery is automatic (hysteresis low-watermarks) the
+   moment pressure clears; every transition is an event + counter;
+5. **admission** — the entry joins the queue, ordered by
    ``(-priority, deadline, arrival)``: higher tenant priority first,
    earlier deadline breaks ties, FIFO within equals. A bounded queue
    (``max_depth``) rejects the overflow with :class:`QueueFull` (HTTP
@@ -22,11 +35,19 @@ time (``status="evicted"``) — a deadline is "answer by", not "try
 anyway"; the scheduler never spends lanes on an answer nobody is
 waiting for.
 
+Per-tenant SLO accounting rides resolution: every entry with a
+deadline lands as a deadline HIT or MISS for its tenant
+(``serve_tenant_deadline_misses_total{tenant=...}``), latency is
+accumulated per tenant, and ``stats()`` surfaces the whole per-tenant
+table for ``/healthz``.
+
 Telemetry: an ``admit`` span per submission, a ``queue_wait`` span per
 entry (emitted when the scheduler pops it, measuring time spent
 queued), ``serve_requests_total`` / ``serve_contracts_total`` /
-``serve_dedupe_hits_total`` / ``serve_evicted_total`` counters and the
-``serve_queue_depth`` gauge.
+``serve_dedupe_hits_total`` / ``serve_evicted_total`` /
+``serve_shed_total{reason}`` / ``serve_quota_rejections_total{tenant}``
+counters and the ``serve_queue_depth`` / ``serve_oldest_entry_age_sec``
+/ ``serve_shed_state`` gauges.
 
 Thread-safety: one condition guards the queue, the in-flight index and
 every entry/submission state transition; HTTP threads submit and wait,
@@ -39,6 +60,7 @@ import itertools
 import os
 import threading
 import time
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..obs import metrics as obs_metrics
@@ -52,6 +74,115 @@ class QueueFull(Exception):
 
 class QueueClosed(Exception):
     """The daemon is draining; no new submissions (HTTP 503)."""
+
+
+class QuotaExceeded(Exception):
+    """One tenant's rate or in-flight quota is spent (HTTP 429 with
+    ``Retry-After``); other tenants are unaffected."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = max(0.1, float(retry_after))
+
+
+@dataclass
+class TenantQuota:
+    """Per-tenant admission limits. ``None`` fields are unlimited.
+
+    ``rate`` is a token bucket over FRESH contracts per second (dedupe
+    hits and shed answers cost nothing — cached answers are the cheap
+    path overload protection exists to preserve); ``burst`` is the
+    bucket capacity (default ``max(8, 2*rate)``); ``max_inflight``
+    caps this tenant's queued+running fresh entries."""
+
+    rate: Optional[float] = None
+    burst: Optional[int] = None
+    max_inflight: Optional[int] = None
+
+    def bucket_cap(self) -> float:
+        if self.burst is not None:
+            return max(1.0, float(self.burst))
+        return max(8.0, 2.0 * float(self.rate or 0.0))
+
+    @classmethod
+    def parse(cls, text: str) -> "TenantQuota":
+        """``"rate[:burst[:max_inflight]]"`` with blank fields meaning
+        unlimited — the ``--quota TENANT=2:8:4`` CLI format."""
+        parts = (text.split(":") + ["", "", ""])[:3]
+        try:
+            return cls(
+                rate=float(parts[0]) if parts[0] else None,
+                burst=int(parts[1]) if parts[1] else None,
+                max_inflight=int(parts[2]) if parts[2] else None)
+        except ValueError:
+            raise ValueError(
+                f"bad quota spec {text!r}; want rate[:burst[:inflight]]"
+                " with numeric or empty fields") from None
+
+
+@dataclass
+class ShedPolicy:
+    """When to degrade low-priority admissions to store-only answers.
+
+    Enter shedding when queue depth ≥ ``depth_hi * max_depth`` OR the
+    oldest queued entry is older than ``age_hi`` seconds; exit when
+    depth and age are back under the low watermarks (hysteresis, so
+    the state doesn't flap at the threshold). Submissions with
+    ``priority <= priority_max`` are the sheddable class — the default
+    priority 0 traffic degrades first, anything explicitly prioritized
+    above it keeps its lane."""
+
+    depth_hi: float = 0.85
+    age_hi: float = 30.0
+    depth_lo: Optional[float] = None   # default: depth_hi / 2
+    age_lo: Optional[float] = None     # default: age_hi / 2
+    priority_max: int = 0
+
+    def lo_marks(self) -> Tuple[float, float]:
+        return (self.depth_lo if self.depth_lo is not None
+                else self.depth_hi / 2.0,
+                self.age_lo if self.age_lo is not None
+                else self.age_hi / 2.0)
+
+
+class _TenantState:
+    """One tenant's token bucket + SLO ledger (guarded by the queue's
+    condition like everything else)."""
+
+    __slots__ = ("tokens", "t_refill", "inflight", "admitted",
+                 "completed", "shed", "deadline_hits",
+                 "deadline_misses", "lat_sum")
+
+    def __init__(self, cap: float):
+        self.tokens = cap
+        self.t_refill = time.monotonic()
+        self.inflight = 0          # fresh entries queued or running
+        self.admitted = 0          # fresh entries ever admitted
+        self.completed = 0         # entries resolved (any provenance)
+        self.shed = 0              # typed shed results (store misses)
+        self.deadline_hits = 0
+        self.deadline_misses = 0
+        self.lat_sum = 0.0
+
+    def refill(self, quota: TenantQuota, now: float) -> None:
+        if quota.rate:
+            cap = quota.bucket_cap()
+            self.tokens = min(
+                cap, self.tokens + (now - self.t_refill) * quota.rate)
+        self.t_refill = now
+
+    def as_dict(self) -> Dict:
+        done = self.completed
+        return {
+            "inflight": self.inflight,
+            "admitted": self.admitted,
+            "completed": done,
+            "shed": self.shed,
+            "deadline_hits": self.deadline_hits,
+            "deadline_misses": self.deadline_misses,
+            "mean_latency_sec": (round(self.lat_sum / done, 4)
+                                 if done else 0.0),
+        }
 
 
 #: config keys that define the ENGINE SHAPE a contract compiles into —
@@ -70,7 +201,8 @@ class Entry:
 
     __slots__ = ("eid", "name", "code", "bch", "cfh", "config",
                  "shape_key", "priority", "deadline", "seq", "state",
-                 "result", "submission", "followers", "t_submit")
+                 "result", "submission", "followers", "t_submit",
+                 "counted_inflight")
 
     def __init__(self, eid: str, name: str, code: bytes, config: Dict,
                  priority: int, deadline: Optional[float], seq: int,
@@ -90,6 +222,9 @@ class Entry:
         self.submission = submission
         self.followers: List["Entry"] = []
         self.t_submit = time.monotonic()
+        #: True while this FRESH entry holds one of its tenant's
+        #: in-flight slots (queued or running; released at resolution)
+        self.counted_inflight = False
 
     @property
     def uname(self) -> str:
@@ -168,18 +303,29 @@ class Submission:
 class AdmissionQueue:
     def __init__(self, store: Optional[ResultsStore] = None,
                  dedupe: bool = True, max_depth: int = 4096,
-                 config_fn: Optional[Callable[[Dict], Dict]] = None):
+                 config_fn: Optional[Callable[[Dict], Dict]] = None,
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 default_quota: Optional[TenantQuota] = None,
+                 shed: Optional[ShedPolicy] = None):
         self.store = store
         self.dedupe = bool(dedupe) and store is not None
         self.max_depth = max(1, int(max_depth))
         #: merges per-request option overrides into the daemon's base
         #: analysis config — the dict that config_hash covers
         self.config_fn = config_fn or (lambda overrides: dict(overrides))
+        #: per-tenant overrides; ``default_quota`` applies to every
+        #: tenant without one (None = unlimited)
+        self.quotas = dict(quotas or {})
+        self.default_quota = default_quota
+        self.shed_policy = shed
+        self.shed_state = "ok"            # "ok" | "shedding"
+        self._shed_reason: Optional[str] = None
         self.closed = False
         self._cond = threading.Condition()
         self._queue: List[Entry] = []
         self._inflight: Dict[Tuple[str, str], Entry] = {}
         self._subs: Dict[str, Submission] = {}
+        self._tenants: Dict[str, _TenantState] = {}
         self._seq = itertools.count()
         self._nsub = itertools.count()
         self._reg = obs_metrics.REGISTRY
@@ -191,21 +337,168 @@ class AdmissionQueue:
             help="entries admitted and not yet scheduled").set(
             len(self._queue))
 
+    def _tenant_locked(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            q = self._quota_for(tenant)
+            st = _TenantState(q.bucket_cap() if q else 0.0)
+            self._tenants[tenant] = st
+        return st
+
+    def _quota_for(self, tenant: str) -> Optional[TenantQuota]:
+        return self.quotas.get(tenant, self.default_quota)
+
+    def _oldest_age_locked(self, now: float) -> float:
+        if not self._queue:
+            return 0.0
+        return now - min(e.t_submit for e in self._queue)
+
+    def _update_shed_locked(self, now: float) -> None:
+        """Shed-state transitions from current pressure (queue depth /
+        oldest-entry age), with hysteresis so the state can't flap at
+        the threshold. Called on every submit and every scheduler
+        drain, so recovery is automatic as pressure clears."""
+        pol = self.shed_policy
+        if pol is None:
+            return
+        depth = len(self._queue)
+        age = self._oldest_age_locked(now)
+        self._reg.gauge(
+            "serve_oldest_entry_age_sec",
+            help="age of the oldest still-queued entry").set(age)
+        if self.shed_state == "ok":
+            reason = None
+            if depth >= pol.depth_hi * self.max_depth:
+                reason = "depth"
+            elif age >= pol.age_hi:
+                reason = "age"
+            if reason:
+                self.shed_state = "shedding"
+                self._shed_reason = reason
+                self._reg.counter(
+                    "serve_shed_transitions_total",
+                    help="shed-state transitions",
+                    labels={"dir": "enter"}).inc()
+                obs_trace.event("shed_enter", reason=reason,
+                                depth=depth, age=round(age, 3))
+        else:
+            depth_lo, age_lo = pol.lo_marks()
+            if depth <= depth_lo * self.max_depth and age <= age_lo:
+                self.shed_state = "ok"
+                self._shed_reason = None
+                self._reg.counter(
+                    "serve_shed_transitions_total",
+                    help="shed-state transitions",
+                    labels={"dir": "exit"}).inc()
+                obs_trace.event("shed_exit", depth=depth,
+                                age=round(age, 3))
+        self._reg.gauge(
+            "serve_shed_state",
+            help="1 while low-priority admissions degrade to "
+                 "store-only answers").set(
+            1.0 if self.shed_state == "shedding" else 0.0)
+
+    def _check_quota_locked(self, tenant: str, fresh: int,
+                            now: float) -> None:
+        """Raise :class:`QuotaExceeded` if admitting ``fresh`` more
+        entries would breach the tenant's in-flight cap or outrun its
+        token bucket; on success the tokens are spent."""
+        quota = self._quota_for(tenant)
+        if quota is None or fresh <= 0:
+            return
+        st = self._tenant_locked(tenant)
+        if (quota.max_inflight is not None
+                and st.inflight + fresh > quota.max_inflight):
+            self._reg.counter(
+                "serve_quota_rejections_total",
+                help="submissions rejected by a per-tenant quota",
+                labels={"tenant": tenant}).inc()
+            obs_trace.event("quota_rejected", tenant=tenant,
+                            reason="inflight", inflight=st.inflight,
+                            fresh=fresh, cap=quota.max_inflight)
+            raise QuotaExceeded(
+                f"tenant {tenant!r} has {st.inflight} entries in "
+                f"flight; +{fresh} would exceed the cap of "
+                f"{quota.max_inflight}", retry_after=1.0)
+        if quota.rate:
+            st.refill(quota, now)
+            if st.tokens < fresh:
+                retry = (fresh - st.tokens) / quota.rate
+                self._reg.counter(
+                    "serve_quota_rejections_total",
+                    help="submissions rejected by a per-tenant quota",
+                    labels={"tenant": tenant}).inc()
+                obs_trace.event("quota_rejected", tenant=tenant,
+                                reason="rate", fresh=fresh,
+                                retry_after=round(retry, 3))
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} admission rate "
+                    f"{quota.rate:g}/s exhausted; retry in "
+                    f"{retry:.1f}s", retry_after=retry)
+            st.tokens -= fresh
+
+    def _shed_submission_locked(self, sub: Submission, config: Dict,
+                                contracts, priority: int,
+                                deadline: Optional[float]) -> None:
+        """Store-only degraded answers for one low-priority submission
+        while shedding: a stored verdict is served
+        (``served_from="shed-store"``), a miss becomes a typed
+        ``status="shed"`` result — an answer either way, never a
+        silent drop, and no lane or queue slot is touched."""
+        st = self._tenant_locked(sub.tenant)
+        for name, code in contracts:
+            e = Entry(f"e{next(self._seq):07d}", str(name),
+                      bytes(code), config, int(priority), deadline,
+                      next(self._seq), sub)
+            sub.entries.append(e)
+            # --no-dedupe disables store ANSWERS here too: shedding
+            # then degrades every low-priority contract to a typed
+            # shed result (the store is neither read nor written)
+            doc = (self.store.get(e.bch, e.cfh)
+                   if self.dedupe else None)
+            if doc is not None:
+                self._reg.counter(
+                    "serve_shed_total",
+                    help="contracts answered degraded under overload",
+                    labels={"reason": "store-hit"}).inc()
+                self._resolve_locked(
+                    e, self._verdict_result(e, doc),
+                    served_from="shed-store")
+            else:
+                st.shed += 1
+                self._reg.counter(
+                    "serve_shed_total",
+                    help="contracts answered degraded under overload",
+                    labels={"reason": "store-miss"}).inc()
+                self._resolve_locked(
+                    e, {"status": "shed",
+                        "error": "daemon overloaded "
+                                 f"({self._shed_reason}); low-priority "
+                                 "work is served from the verdict "
+                                 "store only — no cached verdict for "
+                                 "this contract, resubmit later or "
+                                 "raise priority"},
+                    served_from=None)
+
     def submit(self, contracts: Sequence[Tuple[str, bytes]],
                tenant: str = "default", priority: int = 0,
                deadline_sec: Optional[float] = None,
                options: Optional[Dict] = None) -> Submission:
         """Admit one submission of ``(name, bytecode)`` pairs. Raises
-        :class:`QueueClosed` while draining, :class:`QueueFull` when
-        the whole submission cannot fit (all-or-nothing: a partially
-        admitted submission would stream a partial result set that
-        LOOKS complete)."""
+        :class:`QueueClosed` while draining, :class:`QuotaExceeded` on
+        a per-tenant quota breach, :class:`QueueFull` when the whole
+        submission cannot fit (all-or-nothing: a partially admitted
+        submission would stream a partial result set that LOOKS
+        complete). While shedding, a low-priority submission resolves
+        entirely at admission with store-only answers."""
         config = self.config_fn(dict(options or {}))
         with obs_trace.timer("admit", tenant=tenant,
                              n=len(contracts)) as sp:
             with self._cond:
                 if self.closed:
                     raise QueueClosed("daemon is draining")
+                now = time.monotonic()
+                self._update_shed_locked(now)
                 self._reg.counter(
                     "serve_requests_total",
                     help="submissions accepted for admission").inc()
@@ -213,9 +506,35 @@ class AdmissionQueue:
                     len(contracts))
                 sid = f"s{next(self._nsub):06d}-{os.getpid():x}"
                 sub = Submission(sid, tenant, self._cond)
-                fresh: List[Entry] = []
                 deadline = (None if deadline_sec is None
-                            else time.monotonic() + float(deadline_sec))
+                            else now + float(deadline_sec))
+                if (self.shed_state == "shedding"
+                        and self.shed_policy is not None
+                        and int(priority)
+                        <= self.shed_policy.priority_max):
+                    self._shed_submission_locked(
+                        sub, config, contracts, int(priority),
+                        deadline)
+                    self._subs[sid] = sub
+                    self._cond.notify_all()
+                    sp.attrs["id"] = sub.sid
+                    sp.attrs["shed"] = True
+                    return sub
+                fresh: List[Entry] = []
+
+                def rollback() -> None:
+                    # drop this submission's in-flight registrations
+                    # and followers (resolved store-hits stand — they
+                    # cost nothing, their verdicts are real)
+                    for e in fresh:
+                        e.state = "done"
+                        if self._inflight.get((e.bch, e.cfh)) is e:
+                            del self._inflight[(e.bch, e.cfh)]
+                    for e in sub.entries:
+                        primary = self._inflight.get((e.bch, e.cfh))
+                        if primary is not None and e in primary.followers:
+                            primary.followers.remove(e)
+
                 for name, code in contracts:
                     e = Entry(f"e{next(self._seq):07d}", str(name),
                               bytes(code), config, int(priority),
@@ -251,25 +570,24 @@ class AdmissionQueue:
                         self._inflight[key] = e
                     fresh.append(e)
                 if len(self._queue) + len(fresh) > self.max_depth:
-                    # roll back: drop this submission's in-flight
-                    # registrations and followers (resolved store-hits
-                    # stand — they cost nothing, their verdicts are
-                    # real)
-                    for e in fresh:
-                        e.state = "done"
-                        if self._inflight.get((e.bch, e.cfh)) is e:
-                            del self._inflight[(e.bch, e.cfh)]
-                    for e in sub.entries:
-                        primary = self._inflight.get((e.bch, e.cfh))
-                        if primary is not None and e in primary.followers:
-                            primary.followers.remove(e)
+                    rollback()
                     raise QueueFull(
                         f"queue depth {len(self._queue)} + "
                         f"{len(fresh)} exceeds {self.max_depth}")
+                try:
+                    self._check_quota_locked(tenant, len(fresh), now)
+                except QuotaExceeded:
+                    rollback()
+                    raise
+                st = self._tenant_locked(tenant)
+                st.admitted += len(fresh)
+                st.inflight += len(fresh)
                 for e in fresh:
+                    e.counted_inflight = True
                     self._queue.append(e)
                 self._subs[sid] = sub
                 self._depth_gauge()
+                self._update_shed_locked(now)
                 self._cond.notify_all()
         sp.attrs["id"] = sub.sid
         return sub
@@ -320,7 +638,9 @@ class AdmissionQueue:
                     else time.monotonic() + timeout)
         with self._cond:
             while True:
-                self._evict_expired_locked(time.monotonic())
+                now = time.monotonic()
+                self._evict_expired_locked(now)
+                self._update_shed_locked(now)
                 if self._queue:
                     break
                 remaining = (None if deadline is None
@@ -365,6 +685,30 @@ class AdmissionQueue:
             res["served_from"] = served_from
         e.result = res
         e.submission.results.append(res)
+        # --- per-tenant SLO ledger (docs/serving.md) ---
+        now = time.monotonic()
+        st = self._tenant_locked(e.submission.tenant)
+        st.completed += 1
+        st.lat_sum += now - e.t_submit
+        if e.counted_inflight:
+            e.counted_inflight = False
+            st.inflight = max(0, st.inflight - 1)
+        deadline_hit: Optional[bool] = None
+        if e.deadline is not None:
+            deadline_hit = now <= e.deadline
+            if deadline_hit:
+                st.deadline_hits += 1
+            else:
+                st.deadline_misses += 1
+                self._reg.counter(
+                    "serve_tenant_deadline_misses_total",
+                    help="entries resolved after their deadline",
+                    labels={"tenant": e.submission.tenant}).inc()
+        obs_trace.event("serve_resolved", tenant=e.submission.tenant,
+                        status=res.get("status"),
+                        served_from=served_from,
+                        deadline_hit=deadline_hit,
+                        wait=round(now - e.t_submit, 4))
         for f in e.followers:
             self._resolve_locked(f, self._verdict_result(f, res),
                                  served_from="dedupe-inflight")
@@ -388,6 +732,20 @@ class AdmissionQueue:
     def depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def stats(self) -> Dict:
+        """The admission-side health surface: depth, oldest-entry age,
+        shed state, and the per-tenant SLO table (``/healthz``)."""
+        with self._cond:
+            now = time.monotonic()
+            return {
+                "queue_depth": len(self._queue),
+                "oldest_entry_age_sec": round(
+                    self._oldest_age_locked(now), 3),
+                "shed_state": self.shed_state,
+                "tenants": {t: st.as_dict()
+                            for t, st in sorted(self._tenants.items())},
+            }
 
     def close(self) -> None:
         """Stop admitting (drain begins). Queued entries stay queued —
@@ -415,4 +773,5 @@ class AdmissionQueue:
 
 
 __all__ = ["AdmissionQueue", "Entry", "QueueClosed", "QueueFull",
-           "SHAPE_KEYS", "Submission", "shape_key_of"]
+           "QuotaExceeded", "SHAPE_KEYS", "ShedPolicy", "Submission",
+           "TenantQuota", "shape_key_of"]

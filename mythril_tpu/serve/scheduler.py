@@ -22,6 +22,17 @@ replaces WHERE lanes run.
 
 Single scheduler thread; entry resolution goes through the queue's one
 condition, so HTTP waiters wake exactly when their results commit.
+
+Replica safety (docs/serving.md "Overload & multi-replica serving"):
+N daemons may point at ONE ``--data-dir``. Verdict persistence is
+first-wins (``ResultsStore.put`` via ``exclusive_write``), so two
+replicas racing the same ``(bytecode, config)`` commit exactly one
+file and the loser's copy is dropped (equal by construction) — each
+replica still resolves its own waiters from its own batch result. The
+warm-shape registry and in-flight dedupe index are deliberately
+process-local: warmth is an XLA-cache property of one process, and
+cross-replica dedupe happens through the shared store the moment the
+first replica commits.
 """
 
 from __future__ import annotations
@@ -255,8 +266,10 @@ class Scheduler:
         if hasattr(camp, "shape_is_warm"):
             warm = bool(camp.shape_is_warm())
         items = [(e.uname, e.code) for e in entries]
+        tenants = sorted({e.submission.tenant for e in entries})
         with obs_trace.span("schedule", n=len(entries),
-                            cfh=entries[0].cfh, warm=warm):
+                            cfh=entries[0].cfh, warm=warm,
+                            tenants=tenants):
             out = camp.run_external_batch(items)
         self.batches_run += 1
         self._reg.counter(

@@ -10,12 +10,19 @@ pairs WHAT was analyzed (sha256 of the runtime bytecode) with HOW
 module list, solver knobs) — the same bytecode under a deeper budget is
 a different verdict, never served stale.
 
-Every verdict is one JSON file written with the repo-wide
-``utils/checkpoint.durable_write`` contract (tmp + fsync + atomic
-rename), so a SIGKILL mid-write never leaves a half verdict: the
-restarted daemon either has the verdict or re-analyzes — exactly-once
-either way. Corrupt files are treated as misses (and counted), not
-errors.
+Every verdict is one JSON file created with the repo-wide
+``utils/checkpoint.exclusive_write`` contract (tmp + fsync +
+link-exclusive create) — FIRST WINS, the multi-replica story
+(docs/serving.md "Overload & multi-replica serving"): N daemons on one
+``--data-dir`` may commit the same ``(bytecode, config)`` verdict
+concurrently and exactly one file lands; the losers drop their copies
+(equal by construction) with a ``serve_store_write_races_total`` tick.
+A SIGKILL mid-write never leaves a half verdict: the restarted daemon
+either has the verdict or re-analyzes — exactly-once either way.
+Corrupt files are treated as counted misses, never errors, and are
+UNLINKED on read (mirroring ``smt/vstore.py``) so a first-wins
+re-commit can rewrite them instead of preserving the corruption
+forever.
 """
 
 from __future__ import annotations
@@ -27,7 +34,7 @@ import time
 from typing import Dict, Optional
 
 from ..obs import metrics as obs_metrics
-from ..utils.checkpoint import durable_write
+from ..utils.checkpoint import exclusive_write
 
 #: verdict-file schema (readers reject newer-than-known)
 STORE_SCHEMA = 1
@@ -68,10 +75,10 @@ def config_hash(config: Dict) -> str:
 class ResultsStore:
     """One directory of verdict files: ``<dir>/<bch>.<cfh>.json``.
 
-    Single-writer (the scheduler thread), many readers (HTTP threads,
-    the queue's admission check); file-level atomicity via
-    ``durable_write`` is the whole concurrency story — no lock, no
-    index file to corrupt."""
+    Many writers (N replica daemons' scheduler threads), many readers
+    (HTTP threads, the queue's admission check), across processes and
+    hosts; file-level atomicity via first-wins ``exclusive_write`` is
+    the whole concurrency story — no lock, no index file to corrupt."""
 
     def __init__(self, path: str):
         self.path = path
@@ -80,41 +87,68 @@ class ResultsStore:
     def _file(self, bch: str, cfh: str) -> str:
         return os.path.join(self.path, f"{bch}.{cfh}.json")
 
+    def _corrupt_miss(self, path: str) -> None:
+        """Count and UNLINK one unreadable verdict file so re-analysis
+        can rewrite it (a first-wins create would otherwise preserve
+        the corruption forever)."""
+        obs_metrics.REGISTRY.counter(
+            "serve_store_corrupt_total",
+            help="unreadable verdict files treated as misses "
+                 "(and unlinked)").inc()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
     def get(self, bch: str, cfh: str) -> Optional[Dict]:
         """The stored verdict, or None on miss. A corrupt or
-        newer-schema file is a MISS (re-analysis overwrites it) with a
-        counter tick, never an exception on the admission path."""
+        newer-schema file is a MISS with a counter tick (and the file
+        is removed for rewrite), never an exception on the admission
+        path."""
+        p = self._file(bch, cfh)
         try:
-            with open(self._file(bch, cfh)) as fh:
+            with open(p) as fh:
                 doc = json.load(fh)
         except FileNotFoundError:
             return None
         except (OSError, ValueError):
-            obs_metrics.REGISTRY.counter(
-                "serve_store_corrupt_total",
-                help="unreadable verdict files treated as misses").inc()
+            self._corrupt_miss(p)
             return None
         if (not isinstance(doc, dict)
                 or int(doc.get("schema", 0)) > STORE_SCHEMA
                 or doc.get("bytecode_hash") != bch):
-            obs_metrics.REGISTRY.counter(
-                "serve_store_corrupt_total",
-                help="unreadable verdict files treated as misses").inc()
+            self._corrupt_miss(p)
             return None
         return doc
 
-    def put(self, bch: str, cfh: str, verdict: Dict) -> None:
+    def put(self, bch: str, cfh: str, verdict: Dict) -> bool:
         """Durably persist one verdict (issues + status for one
-        contract under one config)."""
+        contract under one config), first-wins across replicas.
+        Returns whether this caller's file is the one on disk; a
+        losing write is dropped (the verdicts are equal by
+        construction) with a race-counter tick — unless the file on
+        disk is CORRUPT, in which case it is unlinked and the write
+        retried so a torn replica write heals instead of poisoning
+        the key."""
         doc = {"schema": STORE_SCHEMA, "bytecode_hash": bch,
                "config_hash": cfh, "t": round(time.time(), 3)}
         doc.update(verdict)
-        durable_write(self._file(bch, cfh),
-                      json.dumps(doc, sort_keys=True).encode(),
-                      rotate=False)
-        obs_metrics.REGISTRY.counter(
-            "serve_store_writes_total",
-            help="verdicts persisted to the results store").inc()
+        blob = json.dumps(doc, sort_keys=True).encode()
+        won = exclusive_write(self._file(bch, cfh), blob)
+        if not won and self.get(bch, cfh) is None:
+            # the incumbent was corrupt: get() unlinked it — retry
+            won = exclusive_write(self._file(bch, cfh), blob)
+        reg = obs_metrics.REGISTRY
+        if won:
+            reg.counter(
+                "serve_store_writes_total",
+                help="verdicts persisted to the results store").inc()
+        else:
+            reg.counter(
+                "serve_store_write_races_total",
+                help="verdict writes dropped because another replica "
+                     "committed the key first").inc()
+        return won
 
     def count(self) -> int:
         """Number of stored verdicts (healthz diagnostics; O(dir))."""

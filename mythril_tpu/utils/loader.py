@@ -137,6 +137,17 @@ class HttpRpcClient:
     def eth_blockNumber(self) -> str:
         return self._call("eth_blockNumber", [])
 
+    def eth_getBlockByNumber(self, number: str, full: bool = False):
+        """Block document (``None`` for an unknown block). ``full``
+        inlines transaction objects — the serve follower's creation
+        scan (serve/follower.py) needs ``to``/``hash`` per tx."""
+        return self._call("eth_getBlockByNumber", [number, bool(full)])
+
+    def eth_getTransactionReceipt(self, txhash: str):
+        """Receipt document (``None`` while pending) — carries
+        ``contractAddress`` for creation transactions."""
+        return self._call("eth_getTransactionReceipt", [txhash])
+
 
 def rpc_client_from_uri(uri: str):
     """``file:PATH`` -> mock client; anything http(s) -> JSON-RPC."""

@@ -273,6 +273,26 @@ def test_parser_worker_isolation_flag():
                       "--worker-isolation", "sometimes"])
 
 
+def test_parser_serve_overload_flags():
+    p = create_parser()
+    args = p.parse_args(["serve"])
+    assert args.tenant_rate is None and args.quota is None
+    assert args.shed_depth_hi == 0.85 and args.shed_age_hi == 30.0
+    assert args.shed_priority_max == 0 and args.no_shed is False
+    assert args.follow is None and args.follow_poll == 2.0
+    args = p.parse_args([
+        "serve", "--tenant-rate", "2.5", "--tenant-burst", "16",
+        "--tenant-max-inflight", "8", "--quota", "scanner=2:8:4",
+        "--quota", "ops=::64", "--shed-depth-hi", "0.5",
+        "--shed-age-hi", "10", "--shed-priority-max", "1",
+        "--follow", "http://127.0.0.1:8545", "--follow-poll", "0.5"])
+    assert args.tenant_rate == 2.5 and args.tenant_max_inflight == 8
+    assert args.quota == ["scanner=2:8:4", "ops=::64"]
+    assert args.shed_depth_hi == 0.5 and args.shed_priority_max == 1
+    assert args.follow == "http://127.0.0.1:8545"
+    assert p.parse_args(["serve", "--no-shed"]).no_shed is True
+
+
 def test_flag_max_depth_overrides_max_steps(capsys):
     # --max-depth (reference name) wins over the default --max-steps
     rc, out = run_cli(

@@ -149,6 +149,29 @@ def test_metrics_snapshot_shape_and_prometheus():
         reg.gauge("batches_total")
 
 
+def test_metrics_labeled_series_share_one_family():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("shed_total", help="sheds",
+                labels={"reason": "depth"}).inc()
+    reg.counter("shed_total", labels={"reason": "age"}).inc(2)
+    reg.gauge("inflight", labels={"tenant": "a"}).set(3)
+    # label order is canonicalized: same labels -> same series
+    assert (obs_metrics.label_key("x", {"b": 1, "a": 2})
+            == obs_metrics.label_key("x", {"a": 2, "b": 1}))
+    prom = reg.to_prometheus()
+    # ONE header block for the family, one sample line per series
+    assert prom.count("# TYPE mythril_shed_total counter") == 1
+    assert 'mythril_shed_total{reason="depth"} 1' in prom
+    assert 'mythril_shed_total{reason="age"} 2' in prom
+    assert 'mythril_inflight{tenant="a"} 3' in prom
+    # snapshot keys carry the label block (JSON-side disambiguation)
+    snap = reg.snapshot()
+    assert snap["counters"]['shed_total{reason="age"}'] == 2.0
+    # label values are escaped, never able to break the line format
+    reg.counter("esc_total", labels={"v": 'a"b\nc'}).inc()
+    assert 'mythril_esc_total{v="a\\"b c"} 1' in reg.to_prometheus()
+
+
 def test_metrics_write_json_and_prom(tmp_path):
     reg = obs_metrics.MetricsRegistry()
     reg.counter("c").inc()

@@ -295,9 +295,12 @@ def test_http_deadline_eviction(daemon_factory):
 
 
 def test_http_queue_full_429(daemon_factory):
+    # shed disabled: this test pins the BOUNDED-QUEUE contract (the
+    # shed ladder would otherwise answer the overflow degraded with a
+    # 202 — that path has its own tests in test_serve_overload.py)
     gate = threading.Event()
     stub = StubCampaign(gate=gate)
-    dm, url = daemon_factory(stub=stub, max_queue=1,
+    dm, url = daemon_factory(stub=stub, max_queue=1, shed=None,
                              options=ServeOptions(batch_size=1))
     _submit(url, [("a", b"\x01aa")])          # popped -> running
     deadline = time.monotonic() + 5.0

@@ -1,0 +1,323 @@
+"""Overload-safe serving (docs/serving.md "Overload & multi-replica
+serving"): per-tenant token-bucket quotas + max-in-flight caps (429
+with Retry-After, no cross-tenant starvation), the load-shedding
+ladder (overload degrades low-priority submissions to verdict-store-
+only answers — ``served_from="shed-store"`` on a hit, typed
+``status="shed"`` on a miss, never a silent drop, automatic recovery),
+and per-tenant SLO accounting (deadline hits/misses, latency) surfaced
+through ``/healthz`` and labeled ``/metrics`` counters.
+
+The synthetic-overload test is the ISSUE 11 acceptance path: a
+submission rate far past capacity (a gate holds the stub runner) must
+never deadlock or buffer unboundedly — low-priority requests resolve
+degraded at admission, a high-priority request still completes within
+its deadline, and shedding stops by itself when pressure clears.
+"""
+
+import threading
+import time
+import urllib.error
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.obs import metrics as obs_metrics
+from mythril_tpu.serve import (AdmissionQueue, AnalysisDaemon,
+                               QuotaExceeded, ResultsStore,
+                               ServeOptions, ShedPolicy, TenantQuota)
+from mythril_tpu.serve.store import bytecode_hash, config_hash
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+import serve_client  # noqa: E402
+
+ISSUE_CODE = b"\x01" + bytes([9])
+
+
+def counter(name, labels=None):
+    return obs_metrics.REGISTRY.counter(name, labels=labels).value
+
+
+@pytest.fixture(autouse=True)
+def _restore_registry_enabled():
+    was = obs_metrics.REGISTRY.enabled
+    yield
+    obs_metrics.REGISTRY.enabled = was
+
+
+class StubCampaign:
+    """Gated instant-verdict campaign (same protocol as
+    tests/test_serve.py: \\x01-prefixed code -> one issue)."""
+
+    def __init__(self, gate=None):
+        self.gate = gate
+        self.calls = 0
+        self.batches = []
+
+    def shape_is_warm(self):
+        return self.calls > 0
+
+    def run_external_batch(self, items, bi=None):
+        if self.gate is not None:
+            assert self.gate.wait(30.0), "test gate never released"
+        self.calls += 1
+        self.batches.append([n for n, _ in items])
+        issues = [{"contract": n, "swc-id": "106", "title": "stub"}
+                  for n, c in items if c.startswith(b"\x01")]
+        return {"issues": issues, "paths": len(items), "dropped": 0,
+                "iprof": {}, "quarantined": [], "retries": 0,
+                "status": "ok", "batch": self.calls - 1,
+                "wall_sec": 0.0}
+
+
+@pytest.fixture
+def daemon_factory(tmp_path):
+    daemons = []
+
+    def make(stub=None, data_dir=None, **kw):
+        kw.setdefault("options", ServeOptions(batch_size=4))
+        kw.setdefault("drain_timeout", 10.0)
+        kw.setdefault("solver_store", None)
+        factory = (lambda cfg: stub) if stub is not None else None
+        dm = AnalysisDaemon(
+            data_dir=str(data_dir or tmp_path / "serve_data"),
+            port=0, campaign_factory=factory, **kw)
+        dm.start()
+        daemons.append(dm)
+        return dm, f"http://127.0.0.1:{dm.port}"
+
+    yield make
+    for dm in daemons:
+        dm.scheduler.abort()
+        dm.shutdown("test teardown")
+
+
+# --- quota units --------------------------------------------------------
+
+def test_quota_parse_and_bucket_cap():
+    q = TenantQuota.parse("2:8:4")
+    assert (q.rate, q.burst, q.max_inflight) == (2.0, 8, 4)
+    q = TenantQuota.parse("::64")
+    assert (q.rate, q.burst, q.max_inflight) == (None, None, 64)
+    assert TenantQuota.parse("5").burst is None
+    assert TenantQuota(rate=16.0).bucket_cap() == 32.0
+    assert TenantQuota(rate=1.0).bucket_cap() == 8.0
+    with pytest.raises(ValueError, match="bad quota spec"):
+        TenantQuota.parse("fast:please")
+
+
+def test_queue_token_bucket_rate_limit():
+    # burst 2, effectively-zero refill: the third fresh contract must
+    # be rejected with a computed Retry-After, and dedupe-free entries
+    # are the only thing the bucket charges for
+    q = AdmissionQueue(store=None, dedupe=False, max_depth=64,
+                       default_quota=TenantQuota(rate=0.001, burst=2))
+    q.submit([("a", b"\x00a")], tenant="t")
+    q.submit([("b", b"\x00b")], tenant="t")
+    with pytest.raises(QuotaExceeded) as ei:
+        q.submit([("c", b"\x00c")], tenant="t")
+    assert ei.value.retry_after > 100     # (1 token) / 0.001 per sec
+    # a DIFFERENT tenant is untouched — no global starvation
+    q.submit([("d", b"\x00d")], tenant="other")
+
+
+def test_queue_max_inflight_releases_on_resolve(tmp_path):
+    st = ResultsStore(str(tmp_path / "store"))
+    q = AdmissionQueue(store=st, dedupe=True, max_depth=64,
+                       default_quota=TenantQuota(max_inflight=2))
+    q.submit([("a", b"\x00a"), ("b", b"\x00b")], tenant="t")
+    with pytest.raises(QuotaExceeded):
+        q.submit([("c", b"\x00c")], tenant="t")
+    # dedupe hits are FREE: a stored verdict does not consume a slot
+    st.put(bytecode_hash(b"\x00z"), config_hash({}), {"status": "ok",
+                                                      "issues": []})
+    sub = q.submit([("z", b"\x00z")], tenant="t")
+    assert sub.done and sub.results[0]["served_from"] == "dedupe-store"
+    # resolving releases the slots
+    for e in q.pop_batch(4, timeout=0.2):
+        q.resolve(e, {"status": "ok", "issues": []})
+    q.submit([("c2", b"\x00c")], tenant="t")
+
+
+def test_http_quota_429_with_retry_after(daemon_factory):
+    gate = threading.Event()
+    stub = StubCampaign(gate=gate)
+    dm, url = daemon_factory(
+        stub=stub, default_quota=TenantQuota(max_inflight=1),
+        shed=None, options=ServeOptions(batch_size=1))
+    serve_client.submit(url, [("a", b"\x01qa")], tenant="alpha")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        serve_client.submit(url, [("a2", b"\x01qb")], tenant="alpha")
+    assert ei.value.code == 429
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    # tenant beta admits fine while alpha is throttled
+    snap = serve_client.submit(url, [("b", b"\x01qc")], tenant="beta")
+    gate.set()
+    out = serve_client.get_result(url, snap["id"], wait=20.0)
+    assert out["results"][0]["status"] == "ok"
+
+
+# --- shed ladder --------------------------------------------------------
+
+def test_queue_sheds_low_priority_to_store_only(tmp_path):
+    st = ResultsStore(str(tmp_path / "store"))
+    cfh = config_hash({})
+    st.put(bytecode_hash(b"\x01known"), cfh,
+           {"status": "ok", "issues": [{"contract": "x",
+                                        "swc-id": "106"}]})
+    q = AdmissionQueue(store=st, dedupe=True, max_depth=4,
+                       shed=ShedPolicy(depth_hi=0.5, age_hi=999.0,
+                                       priority_max=0))
+    hit0 = counter("serve_shed_total", labels={"reason": "store-hit"})
+    miss0 = counter("serve_shed_total", labels={"reason": "store-miss"})
+    # two fresh high-priority entries -> depth 2 >= 0.5*4 -> shedding
+    q.submit([("h1", b"\x00h1"), ("h2", b"\x00h2")], priority=5)
+    assert q.shed_state == "shedding"
+    # low-priority submission now resolves at admission: store hit ->
+    # shed-store answer, miss -> typed shed result; nothing queued
+    sub = q.submit([("cached", b"\x01known"), ("fresh", b"\x00nope")])
+    assert sub.done and q.depth() == 2
+    by = {r["name"]: r for r in sub.results}
+    assert by["cached"]["served_from"] == "shed-store"
+    assert by["cached"]["issues"][0]["contract"] == "cached"
+    assert by["fresh"]["status"] == "shed"
+    assert "overloaded" in by["fresh"]["error"]
+    assert counter("serve_shed_total",
+                   labels={"reason": "store-hit"}) - hit0 == 1
+    assert counter("serve_shed_total",
+                   labels={"reason": "store-miss"}) - miss0 == 1
+    # high priority still takes the normal path while shedding
+    q.submit([("h3", b"\x00h3")], priority=5)
+    assert q.depth() == 3
+    # drain -> automatic recovery (hysteresis low watermark)
+    while q.depth():
+        for e in q.pop_batch(4, timeout=0.2):
+            q.resolve(e, {"status": "ok", "issues": []})
+    q.pop_batch(1, timeout=0.05)     # one idle drain updates the state
+    assert q.shed_state == "ok"
+    # and low-priority work is admitted normally again
+    q.submit([("after", b"\x00after")])
+    assert q.depth() == 1
+
+
+def test_overload_never_deadlocks_high_priority_meets_deadline(
+        tmp_path, daemon_factory):
+    """ISSUE 11 overload proof: submission rate >> capacity with a
+    stub runner. Low-priority requests get shed-store or typed shed
+    results, a high-priority request completes within its deadline,
+    shedding stops automatically when pressure clears, and the queue
+    never grows past its bound."""
+    gate = threading.Event()
+    stub = StubCampaign(gate=gate)
+    dm, url = daemon_factory(
+        stub=stub, max_queue=6,
+        shed=ShedPolicy(depth_hi=0.5, age_hi=999.0, priority_max=0),
+        options=ServeOptions(batch_size=1))
+    # seed the store with one known verdict so shed can serve it
+    cfh = config_hash(dm.options.effective({}))
+    dm.store.put(bytecode_hash(b"\x01seed"), cfh,
+                 {"status": "ok", "issues": [{"contract": "seed",
+                                              "swc-id": "106"}]})
+    enter0 = counter("serve_shed_transitions_total",
+                     labels={"dir": "enter"})
+    exit0 = counter("serve_shed_transitions_total",
+                    labels={"dir": "exit"})
+    # flood: way past capacity (the gate holds every batch)
+    shed_results, sids = [], []
+    for k in range(12):
+        snap = serve_client.submit(
+            url, [(f"low{k}", b"\x02" + bytes([k]))], tenant="flood")
+        sids.append(snap["id"])
+        shed_results.extend(r for r in snap["results"]
+                            if r.get("status") == "shed")
+    assert dm.queue.depth() <= 6          # bounded, not buffering
+    assert dm.queue.shed_state == "shedding"
+    assert shed_results, "overflow must resolve as typed shed results"
+    # a known bytecode is answered from the store even while shedding
+    snap = serve_client.submit(url, [("seeded", b"\x01seed")],
+                               tenant="flood")
+    assert snap["results"][0]["served_from"] == "shed-store"
+    assert len(snap["results"][0]["issues"]) == 1
+    # high priority cuts through and meets its deadline
+    hi = serve_client.submit(url, [("vip", b"\x01vip")],
+                             tenant="vip", priority=5,
+                             deadline_sec=30.0)
+    gate.set()
+    out = serve_client.get_result(url, hi["id"], wait=30.0)
+    assert out["state"] == "done"
+    assert out["results"][0]["status"] == "ok"
+    # every flooded submission resolved (shed or analyzed) — nothing
+    # hangs, nothing is silently dropped
+    for sid in sids:
+        res = serve_client.get_result(url, sid, wait=30.0)
+        assert res["state"] == "done"
+    # pressure cleared -> automatic recovery, events + counters on
+    # record
+    deadline = time.monotonic() + 10.0
+    while (dm.queue.shed_state != "ok"
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    health = serve_client.healthz(url)
+    assert health["shed_state"] == "ok"
+    assert counter("serve_shed_transitions_total",
+                   labels={"dir": "enter"}) - enter0 >= 1
+    assert counter("serve_shed_transitions_total",
+                   labels={"dir": "exit"}) - exit0 >= 1
+    # vip's deadline landed as a HIT in the tenant SLO table
+    vip = health["tenants"]["vip"]
+    assert vip["deadline_hits"] == 1 and vip["deadline_misses"] == 0
+
+
+# --- SLO accounting + health/metrics surface ----------------------------
+
+def test_deadline_hit_and_miss_accounting(tmp_path):
+    q = AdmissionQueue(store=None, dedupe=False, max_depth=8)
+    miss0 = counter("serve_tenant_deadline_misses_total",
+                    labels={"tenant": "slo"})
+    q.submit([("fast", b"\x00f")], tenant="slo", deadline_sec=60.0)
+    (e,) = q.pop_batch(1, timeout=0.2)
+    q.resolve(e, {"status": "ok", "issues": []})
+    # a deadline that lapses while queued is EVICTED -> counted miss
+    q.submit([("late", b"\x00l")], tenant="slo", deadline_sec=0.01)
+    time.sleep(0.05)
+    assert q.pop_batch(1, timeout=0.2) == []      # evicted, not popped
+    st = q.stats()["tenants"]["slo"]
+    assert st["deadline_hits"] == 1
+    assert st["deadline_misses"] == 1
+    assert st["completed"] == 2
+    assert counter("serve_tenant_deadline_misses_total",
+                   labels={"tenant": "slo"}) - miss0 == 1
+
+
+def test_healthz_overload_fields_and_labeled_metrics(daemon_factory):
+    import re
+
+    stub = StubCampaign()
+    dm, url = daemon_factory(stub=stub)
+    serve_client.submit(url, [("k", ISSUE_CODE)], tenant="obs",
+                        deadline_sec=60.0)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        health = serve_client.healthz(url)
+        if health["tenants"].get("obs", {}).get("completed"):
+            break
+        time.sleep(0.05)
+    assert health["shed_state"] == "ok"
+    assert "queue_depth" in health and "oldest_entry_age_sec" in health
+    obs = health["tenants"]["obs"]
+    assert obs["admitted"] == 1 and obs["completed"] == 1
+    assert obs["inflight"] == 0 and obs["deadline_hits"] == 1
+    text = serve_client.metrics(url)
+    assert "mythril_serve_queue_depth" in text
+    assert "mythril_serve_oldest_entry_age_sec" in text
+    # labeled families render one TYPE header and per-series lines
+    line_re = re.compile(
+        r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*"
+        r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE.+-]+)$")
+    for ln in text.splitlines():
+        if ln:
+            assert line_re.match(ln), f"bad prometheus line: {ln!r}"

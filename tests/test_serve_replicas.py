@@ -1,0 +1,253 @@
+"""Multi-replica shared stores under real contention (docs/serving.md
+"Overload & multi-replica serving"): N serve daemons pointed at ONE
+``--data-dir`` must be correct. The verdict store is first-wins
+(``exclusive_write``): concurrent identical commits land exactly one
+file, losers drop their equal-by-construction copies with a race
+counter tick, corrupt files are unlinked on read so a re-commit heals
+them. The fast tests drive two in-process daemons over the real HTTP
+surface; the slow test runs two real ``mythril_tpu serve``
+SUBPROCESSES (the ISSUE 11 replica proof; chaos ``replica`` cells and
+soak leg 12 cover the kill-mid-batch side).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.obs import metrics as obs_metrics
+from mythril_tpu.serve import (AnalysisDaemon, ResultsStore,
+                               ServeOptions)
+from mythril_tpu.serve.store import bytecode_hash, config_hash
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+import serve_client  # noqa: E402
+
+
+def counter(name):
+    return obs_metrics.REGISTRY.counter(name).value
+
+
+@pytest.fixture(autouse=True)
+def _restore_registry_enabled():
+    was = obs_metrics.REGISTRY.enabled
+    yield
+    obs_metrics.REGISTRY.enabled = was
+
+
+class GatedStub:
+    """Stub campaign that signals when a batch arrives and holds it on
+    a gate — the window two replicas race the same store key in."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.started = threading.Event()
+        self.calls = 0
+
+    def shape_is_warm(self):
+        return self.calls > 0
+
+    def run_external_batch(self, items, bi=None):
+        self.started.set()
+        assert self.gate.wait(30.0), "test gate never released"
+        self.calls += 1
+        issues = [{"contract": n, "swc-id": "106", "title": "stub"}
+                  for n, c in items if c.startswith(b"\x01")]
+        return {"issues": issues, "paths": len(items), "dropped": 0,
+                "iprof": {}, "quarantined": [], "retries": 0,
+                "status": "ok", "batch": self.calls - 1,
+                "wall_sec": 0.0}
+
+
+# --- store first-wins units ---------------------------------------------
+
+def test_store_first_wins_and_race_counter(tmp_path):
+    st1 = ResultsStore(str(tmp_path / "store"))
+    st2 = ResultsStore(str(tmp_path / "store"))   # a second "replica"
+    bch, cfh = bytecode_hash(b"\x01rw"), config_hash({"max_steps": 64})
+    races0 = counter("serve_store_write_races_total")
+    assert st1.put(bch, cfh, {"status": "ok", "issues": []}) is True
+    assert st2.put(bch, cfh, {"status": "ok", "issues": []}) is False
+    assert counter("serve_store_write_races_total") - races0 == 1
+    assert st1.get(bch, cfh)["status"] == "ok"
+    assert st1.count() == 1
+
+
+def test_store_corrupt_file_unlinked_and_rewritten(tmp_path):
+    st = ResultsStore(str(tmp_path / "store"))
+    bch, cfh = bytecode_hash(b"\x01cx"), config_hash({})
+    assert st.put(bch, cfh, {"status": "ok", "issues": []})
+    p = os.path.join(str(tmp_path / "store"), f"{bch}.{cfh}.json")
+    raw = open(p, "rb").read()
+    with open(p, "wb") as fh:
+        fh.write(raw[: len(raw) // 2])            # torn replica write
+    c0 = counter("serve_store_corrupt_total")
+    assert st.get(bch, cfh) is None               # counted miss...
+    assert counter("serve_store_corrupt_total") - c0 == 1
+    assert not os.path.exists(p)                  # ...and unlinked
+    assert st.put(bch, cfh, {"status": "ok", "issues": []}) is True
+    assert st.get(bch, cfh)["status"] == "ok"
+
+
+def test_store_put_heals_corrupt_incumbent_without_prior_get(tmp_path):
+    # a replica that never READ the torn file must still win the
+    # rewrite: put's losing path re-checks the incumbent and retries
+    st = ResultsStore(str(tmp_path / "store"))
+    bch, cfh = bytecode_hash(b"\x01hz"), config_hash({})
+    p = os.path.join(str(tmp_path / "store"), f"{bch}.{cfh}.json")
+    with open(p, "w") as fh:
+        fh.write('{"half')
+    assert st.put(bch, cfh, {"status": "ok", "issues": []}) is True
+    assert json.load(open(p))["status"] == "ok"
+
+
+# --- two in-process daemons, one data dir -------------------------------
+
+def test_two_daemons_one_data_dir_contention(tmp_path):
+    """Concurrent identical submissions to two replicas sharing one
+    data dir: both analyze (in-flight dedupe is process-local), the
+    store commit races first-wins to exactly ONE verdict file, both
+    waiters resolve, and afterwards BOTH replicas serve dedupe hits.
+    Distinct submissions land distinct files."""
+    data_dir = str(tmp_path / "shared")
+    stubs = [GatedStub(), GatedStub()]
+    daemons = []
+    try:
+        for stub in stubs:
+            dm = AnalysisDaemon(
+                data_dir=data_dir, port=0, solver_store=None,
+                options=ServeOptions(batch_size=4),
+                campaign_factory=(lambda cfg, s=stub: s))
+            dm.start()
+            daemons.append(dm)
+        urls = [f"http://127.0.0.1:{dm.port}" for dm in daemons]
+        races0 = counter("serve_store_write_races_total")
+        same = b"\x01same"
+        sids = [serve_client.submit(u, [("dup", same)],
+                                    tenant="race")["id"]
+                for u in urls]
+        # both replicas must be IN the batch before either commits —
+        # that is the store-write race window
+        for stub in stubs:
+            assert stub.started.wait(10.0)
+        for stub in stubs:
+            stub.gate.set()
+        outs = [serve_client.get_result(u, sid, wait=20.0)
+                for u, sid in zip(urls, sids)]
+        assert all(o["state"] == "done" for o in outs)
+        assert all(o["results"][0]["status"] == "ok" for o in outs)
+        assert all(len(o["results"][0]["issues"]) == 1 for o in outs)
+        # exactly-once on disk, and the loser counted its race
+        assert daemons[0].store.count() == 1
+        assert counter("serve_store_write_races_total") - races0 == 1
+        # both replicas now serve the shared verdict from dedupe
+        for u in urls:
+            snap = serve_client.submit(u, [("again", same)])
+            assert snap["results"][0]["served_from"] == "dedupe-store"
+            assert len(snap["results"][0]["issues"]) == 1
+        # distinct concurrent submissions -> distinct files
+        for stub in stubs:
+            stub.started.clear()
+        sids = [serve_client.submit(u, [(f"d{k}", b"\x01d%d" % k)],
+                                    tenant="race")["id"]
+                for k, u in enumerate(urls)]
+        for u, sid in zip(urls, sids):
+            assert serve_client.get_result(
+                u, sid, wait=20.0)["state"] == "done"
+        assert daemons[0].store.count() == 3
+    finally:
+        for dm in daemons:
+            dm.scheduler.abort()
+            dm.shutdown("test teardown")
+
+
+# --- two REAL daemon subprocesses (the ISSUE 11 replica proof) ----------
+
+def _start_replica(tmp_path, tag, data_dir):
+    pf = str(tmp_path / f"port_{tag}")
+    cmd = [sys.executable, "-m", "mythril_tpu", "serve",
+           "--port", "0", "--port-file", pf, "--data-dir", data_dir,
+           "--batch-size", "2", "--lanes-per-contract", "8",
+           "--max-steps", "64", "-t", "1",
+           "-m", "AccidentallyKillable", "--limits-profile", "test",
+           "--drain-timeout", "2"]
+    proc = subprocess.Popen(cmd, cwd=ROOT,
+                            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                            stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 120
+    while not os.path.exists(pf):
+        assert proc.poll() is None and time.monotonic() < deadline, \
+            f"replica {tag} failed to start"
+        time.sleep(0.1)
+    with open(pf) as fh:
+        return proc, f"http://127.0.0.1:{fh.read().strip()}"
+
+
+@pytest.mark.slow
+def test_two_subprocess_replicas_exactly_once(tmp_path):
+    """Two real daemon processes, one ``--data-dir``: concurrent
+    identical + distinct submissions complete on both, the shared
+    store holds exactly one verdict file per distinct
+    ``(bytecode, config)``, and both replicas serve dedupe hits on
+    resubmission — with no corrupt-store regressions."""
+    from mythril_tpu.disassembler.asm import assemble
+
+    data_dir = str(tmp_path / "shared")
+    contracts = [(f"c{i:03d}",
+                  assemble(i, "SELFDESTRUCT") if i % 2 == 0
+                  else assemble(1, i, "SSTORE", "STOP"))
+                 for i in range(4)]
+    pa, url_a = _start_replica(tmp_path, "a", data_dir)
+    pb, url_b = _start_replica(tmp_path, "b", data_dir)
+    try:
+        outs = {}
+
+        def drive(tag, url):
+            sid = serve_client.submit(url, contracts,
+                                      tenant=f"rep-{tag}")["id"]
+            outs[tag] = serve_client.get_result(url, sid, wait=600.0)
+
+        threads = [threading.Thread(target=drive, args=(t, u))
+                   for t, u in (("a", url_a), ("b", url_b))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600.0)
+        assert set(outs) == {"a", "b"}
+        issue_sets = []
+        for tag in ("a", "b"):
+            res = outs[tag]
+            assert res["state"] == "done"
+            names = sorted(r["name"] for r in res["results"])
+            assert names == sorted(n for n, _ in contracts)
+            assert all(r["status"] == "ok" for r in res["results"])
+            issue_sets.append(sorted(
+                i["contract"] for r in res["results"]
+                for i in (r.get("issues") or [])))
+        assert issue_sets[0] == issue_sets[1] == ["c000", "c002"]
+        # exactly-once verdict persistence on the shared store
+        store_dir = os.path.join(data_dir, "store")
+        files = [f for f in os.listdir(store_dir)
+                 if f.endswith(".json")]
+        assert len(files) == len(contracts)
+        for f in files:                       # no corrupt regressions
+            doc = json.load(open(os.path.join(store_dir, f)))
+            assert doc["status"] == "ok"
+        # both replicas answer a resubmission from the shared store
+        for url in (url_a, url_b):
+            snap = serve_client.submit(url, contracts, tenant="again")
+            assert snap["state"] == "done"
+            assert all(r["served_from"] == "dedupe-store"
+                       for r in snap["results"])
+    finally:
+        for p in (pa, pb):
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+                p.wait(timeout=60)

@@ -34,12 +34,23 @@ Injection points (columns):
   frozen-heartbeat     a worker claims a lease and never heartbeats
                        (wedged before its first renew); a live worker
                        must reclaim after the TTL
+  kill-replica-mid-batch  two REAL serve daemons share one --data-dir;
+                       replica A is SIGKILLed while a batch is in
+                       flight (an injected hang holds it); replica B
+                       must answer the full corpus — A's committed
+                       verdicts from the shared store, the rest fresh
+                       — with exactly-once results and issue parity
+  torn-store-verdict   truncate a committed verdict file in the shared
+                       store mid-byte; the next replica must count it
+                       a corrupt miss, re-analyze, and REWRITE it
 
 Modes (rows): ``batch`` (serial campaign), ``pipelined`` (depth-1
 pipeline), ``fleet`` (work-ledger campaign), ``serve`` (in-process
-always-on daemon). Worker-signal points run with
-``worker_isolation=on``; ledger points exercise the fleet machinery
-directly. Not every point applies to every mode — see ``MATRIX``.
+always-on daemon), ``replica`` (N real serve daemon SUBPROCESSES on
+one shared data dir — docs/serving.md "Overload & multi-replica
+serving"). Worker-signal points run with ``worker_isolation=on``;
+ledger points exercise the fleet machinery directly. Not every point
+applies to every mode — see ``MATRIX``.
 
 CPU-only, TEST_LIMITS, deterministic (``once=`` cookie files make each
 worker fault fire exactly once across restarts). Prints one JSON line
@@ -82,6 +93,7 @@ MATRIX: Dict[str, Tuple[str, ...]] = {
     "pipelined": tuple(_WORKER_POINTS),
     "fleet": tuple(_WORKER_POINTS) + ("torn-ledger", "frozen-heartbeat"),
     "serve": tuple(_WORKER_POINTS),
+    "replica": ("kill-replica-mid-batch", "torn-store-verdict"),
 }
 
 N = 6  # distinct bytecodes (serve dedupe would collapse clones)
@@ -312,6 +324,154 @@ def _cell_serve(point: str, d: str, contracts,
     return cell
 
 
+def _start_replica(d: str, tag: str, data_dir: str,
+                   fault: Optional[str] = None):
+    """One REAL serve daemon subprocess on the shared data dir;
+    returns ``(proc, base_url)`` once it is listening."""
+    import subprocess
+
+    pf = os.path.join(d, f"port_{tag}")
+    cmd = [sys.executable, "-m", "mythril_tpu", "serve",
+           "--port", "0", "--port-file", pf, "--data-dir", data_dir,
+           "--batch-size", "2", "--lanes-per-contract", "8",
+           "--max-steps", "64", "-t", "1",
+           "-m", "AccidentallyKillable", "--limits-profile", "test",
+           "--drain-timeout", "2"]
+    if fault:
+        cmd += ["--fault-inject", fault]
+    proc = subprocess.Popen(cmd, cwd=ROOT,
+                            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                            stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 120
+    while not os.path.exists(pf):
+        if proc.poll() is not None or time.monotonic() > deadline:
+            raise RuntimeError(f"replica {tag} failed to start")
+        time.sleep(0.1)
+    with open(pf) as fh:
+        return proc, f"http://127.0.0.1:{fh.read().strip()}"
+
+
+def _cell_replica_kill(d: str, contracts, baseline: List[str]) -> Dict:
+    """Two live replicas, one data dir: SIGKILL replica A mid-batch
+    (harder than the soak's SIGTERM — no drain, no persist-on-exit),
+    the surviving replica must answer everything exactly once, serving
+    A's committed verdicts from the shared first-wins store."""
+    import re
+    import signal
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import serve_client
+
+    dd = os.path.join(d, "sd")
+    pa, url_a = _start_replica(d, "a", dd, fault="hang:batch=1")
+    pb, url_b = _start_replica(d, "b", dd)
+    try:
+        sid = serve_client.submit(url_a, contracts,
+                                  tenant="chaos")["id"]
+        committed = 0
+        deadline = time.monotonic() + 300
+        while committed < 2 and time.monotonic() < deadline:
+            committed = serve_client.get_result(
+                url_a, sid, wait=2.0)["completed"]
+        pa.send_signal(signal.SIGKILL)
+        pa.wait(timeout=60)
+        final = serve_client.get_result(
+            url_b, serve_client.submit(url_b, contracts,
+                                       tenant="chaos")["id"],
+            wait=600.0)
+        met = serve_client.metrics(url_b)
+    finally:
+        for p in (pa, pb):
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+                p.wait(timeout=60)
+    results = final["results"]
+    by_name: Dict[str, int] = {}
+    for r in results:
+        by_name[r["name"]] = by_name.get(r["name"], 0) + 1
+    issues = sorted(i["contract"] for r in results
+                    for i in (r.get("issues") or []))
+    from_store = sorted(r["name"] for r in results
+                        if r.get("served_from") == "dedupe-store")
+    m = re.search(r"^mythril_serve_dedupe_hits_total (\d+)", met,
+                  re.MULTILINE)
+    cell = {"pre_kill_committed": committed,
+            "completed": final["completed"], "state": final["state"],
+            "from_store": from_store, "issues": issues,
+            "b_dedupe_hits": int(m.group(1)) if m else -1}
+    cell["ok"] = (committed >= 2
+                  and final["state"] == "done"
+                  and final["completed"] == N
+                  and all(n == 1 for n in by_name.values())
+                  and len(from_store) >= 2        # A's commits served by B
+                  and issues == baseline)
+    return cell
+
+
+def _cell_replica_torn_store(d: str, contracts,
+                             baseline: List[str]) -> Dict:
+    """A committed verdict file torn mid-byte in the shared store: the
+    next replica must count a corrupt miss, unlink, re-analyze the one
+    contract, and leave a clean rewritten verdict behind."""
+    import re
+    import signal
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import serve_client
+
+    dd = os.path.join(d, "sd")
+    pa, url_a = _start_replica(d, "a", dd)
+    try:
+        first = serve_client.get_result(
+            url_a, serve_client.submit(url_a, contracts,
+                                       tenant="chaos")["id"],
+            wait=600.0)
+    finally:
+        pa.send_signal(signal.SIGTERM)
+        pa.wait(timeout=60)
+    store_dir = os.path.join(dd, "store")
+    victims = sorted(f for f in os.listdir(store_dir)
+                     if f.endswith(".json"))
+    torn = os.path.join(store_dir, victims[0]) if victims else None
+    if torn:
+        raw = open(torn, "rb").read()
+        with open(torn, "wb") as fh:
+            fh.write(raw[:len(raw) // 2])
+    pb, url_b = _start_replica(d, "b", dd)
+    try:
+        final = serve_client.get_result(
+            url_b, serve_client.submit(url_b, contracts,
+                                       tenant="chaos")["id"],
+            wait=600.0)
+        met = serve_client.metrics(url_b)
+    finally:
+        pb.send_signal(signal.SIGTERM)
+        pb.wait(timeout=60)
+    m = re.search(r"^mythril_serve_store_corrupt_total (\d+)", met,
+                  re.MULTILINE)
+    corrupt = int(m.group(1)) if m else 0
+    rewritten = False
+    if torn and os.path.exists(torn):
+        try:
+            json.load(open(torn))
+            rewritten = True
+        except ValueError:
+            pass
+    issues = sorted(i["contract"] for r in final["results"]
+                    for i in (r.get("issues") or []))
+    from_store = sum(1 for r in final["results"]
+                     if r.get("served_from") == "dedupe-store")
+    cell = {"tore": bool(torn), "corrupt_misses": corrupt,
+            "rewritten": rewritten, "from_store": from_store,
+            "completed": final["completed"], "issues": issues}
+    cell["ok"] = (torn is not None and corrupt >= 1 and rewritten
+                  and final["state"] == "done"
+                  and final["completed"] == N
+                  and from_store == N - 1   # only the torn one re-ran
+                  and issues == baseline)
+    return cell
+
+
 def run_cell(mode: str, point: str, contracts,
              baseline: List[str]) -> Dict:
     with tempfile.TemporaryDirectory() as d:
@@ -326,6 +486,10 @@ def run_cell(mode: str, point: str, contracts,
             return _cell_torn_ledger(d, contracts, baseline)
         if mode == "fleet" and point == "frozen-heartbeat":
             return _cell_frozen_heartbeat(d, contracts, baseline)
+        if mode == "replica" and point == "kill-replica-mid-batch":
+            return _cell_replica_kill(d, contracts, baseline)
+        if mode == "replica" and point == "torn-store-verdict":
+            return _cell_replica_torn_store(d, contracts, baseline)
         raise ValueError(f"cell {mode}:{point} is not in the matrix")
 
 

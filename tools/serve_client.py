@@ -254,6 +254,12 @@ def main() -> int:
                   f"{snap['contracts']} results", file=sys.stderr)
 
     done = sum(1 for r in results if r.get("status") == "ok")
+    # provenance breakdown: how each answer was served (fresh
+    # analysis, dedupe-store/-inflight, or shed-store under overload)
+    served_from: Dict[str, int] = {}
+    for r in results:
+        k = r.get("served_from") or "analysis"
+        served_from[k] = served_from.get(k, 0) + 1
     out = {
         "id": sid,
         "contracts": len(contracts),
@@ -263,6 +269,9 @@ def main() -> int:
         "dedupe_served": sum(1 for r in results
                              if r.get("served_from",
                                       "").startswith("dedupe")),
+        "served_from": served_from,
+        "shed": sum(1 for r in results
+                    if r.get("status") == "shed"),
         "submit_sec": round(t_submit, 4),
         "latency": percentiles(lat),
         "results": results,

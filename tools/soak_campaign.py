@@ -65,6 +65,17 @@ Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
      parity with an uninjected baseline, exactly-once accounting, and
      the recovery events on record. The full matrix is the
      pre-release gate; this leg keeps the boundary honest per-change.
+ 12. replicas — multi-replica shared state under a hard kill
+     (docs/serving.md "Overload & multi-replica serving"): TWO serve
+     daemons as real subprocesses on ONE --data-dir; the corpus is
+     submitted to replica A, which commits batch 0's verdicts to the
+     shared first-wins store and then hangs on batch 1 (injected);
+     A is SIGKILLed mid-batch — no drain, no persist-on-exit — and
+     the SAME corpus goes to replica B, which must serve A's two
+     committed verdicts from the shared store and analyze only the
+     rest: every contract exactly once, issue parity with a batch
+     run, and a final full resubmission to B answered 100% from
+     dedupe (the merged exactly-once check).
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
@@ -122,7 +133,8 @@ SAFE = assemble(1, 0, "SSTORE", "STOP")
 N = 6  # even indices killable -> expected issues c000/c002/c004
 
 LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry",
-        "pipeline", "fleet", "serve", "solver_store", "chaos")
+        "pipeline", "fleet", "serve", "solver_store", "chaos",
+        "replicas")
 
 
 def write_corpus(d: str) -> str:
@@ -572,6 +584,104 @@ def main() -> int:
                    and issues == base_issues
                    and sorted(i["contract"] for i in r10a.issues)
                    == base_issues)
+
+        if "replicas" in want:
+            # leg 12: kill one replica mid-batch, the other answers —
+            # the multi-replica shared-store contract end to end with
+            # real processes and a real SIGKILL (no drain)
+            import signal
+            import subprocess
+            import time as _time
+
+            sys.path.insert(0, os.path.join(ROOT, "tools"))
+            import serve_client
+
+            contracts = [
+                (f"c{i:03d}",
+                 assemble(i, "SELFDESTRUCT") if i % 2 == 0
+                 else assemble(1, i, "SSTORE", "STOP"))
+                for i in range(N)]
+            dd = os.path.join(d, "replica_data")
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+            def start_replica(tag, fault=None):
+                pf = os.path.join(d, f"rport_{tag}")
+                cmd = [sys.executable, "-m", "mythril_tpu", "serve",
+                       "--port", "0", "--port-file", pf,
+                       "--data-dir", dd, "--batch-size", "2",
+                       "--lanes-per-contract", "8",
+                       "--max-steps", "64", "-t", "1",
+                       "-m", "AccidentallyKillable",
+                       "--limits-profile", "test",
+                       "--drain-timeout", "2"]
+                if fault:
+                    cmd += ["--fault-inject", fault]
+                proc = subprocess.Popen(cmd, env=env, cwd=ROOT,
+                                        stderr=subprocess.DEVNULL)
+                deadline = _time.monotonic() + 120
+                while not os.path.exists(pf):
+                    if (proc.poll() is not None
+                            or _time.monotonic() > deadline):
+                        raise RuntimeError(
+                            f"replica {tag} failed to start")
+                    _time.sleep(0.1)
+                with open(pf) as fh:
+                    return proc, f"http://127.0.0.1:{fh.read().strip()}"
+
+            pa, url_a = start_replica("a", fault="hang:batch=1")
+            pb, url_b = start_replica("b")
+            try:
+                sid = serve_client.submit(url_a, contracts,
+                                          tenant="soak")["id"]
+                committed = 0
+                deadline = _time.monotonic() + 300
+                while committed < 2 and _time.monotonic() < deadline:
+                    committed = serve_client.get_result(
+                        url_a, sid, wait=2.0)["completed"]
+                pa.send_signal(signal.SIGKILL)
+                pa.wait(timeout=60)
+                final = serve_client.get_result(
+                    url_b, serve_client.submit(url_b, contracts,
+                                               tenant="soak")["id"],
+                    wait=300.0)
+                # merged exactly-once: a full resubmission answers
+                # 100% from the now-complete shared store
+                again = serve_client.get_result(
+                    url_b, serve_client.submit(url_b, contracts,
+                                               tenant="soak")["id"],
+                    wait=60.0)
+            finally:
+                for p in (pa, pb):
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGTERM)
+                        p.wait(timeout=60)
+            results = final["results"]
+            by_name = {}
+            for r in results:
+                by_name.setdefault(r["name"], []).append(r)
+            issues = sorted(i["contract"] for r in results
+                            for i in (r.get("issues") or []))
+            from_store = sorted(
+                r["name"] for r in results
+                if r.get("served_from") == "dedupe-store")
+            legs["replicas"] = {
+                "pre_kill_committed": committed,
+                "completed": final["completed"],
+                "state": final["state"],
+                "from_store": from_store,
+                "issues": issues,
+                "resubmit_all_dedupe": all(
+                    r.get("served_from") == "dedupe-store"
+                    for r in again["results"]),
+            }
+            ok &= (committed == 2
+                   and final["state"] == "done"
+                   and final["completed"] == N
+                   and all(len(v) == 1 for v in by_name.values())
+                   and from_store == ["c000", "c001"]
+                   and issues == ["c000", "c002", "c004"]
+                   and again["state"] == "done"
+                   and legs["replicas"]["resubmit_all_dedupe"])
 
         if "chaos" in want:
             # leg 11: the reduced chaos matrix (one engine-worker

@@ -17,7 +17,12 @@ Reads either output of the span tracer — the Chrome-trace JSON
      rate — the silent-false-negative channel, docs/solver.md),
   8. a solver portfolio ladder (per-stage attempts / hits / hit rate /
      time across lru -> refute -> probe -> store -> search, plus the
-     Z3-avoided headline — docs/solver.md).
+     Z3-avoided headline — docs/solver.md),
+  9. a serve admission summary (docs/serving.md "Overload &
+     multi-replica serving"): the shed/quota timeline (every
+     shed_enter / shed_exit / quota_rejected, in order) and a
+     per-tenant table of resolutions, shed answers and deadline
+     hits/misses from the per-entry serve_resolved events.
 
 Usage:
     python tools/trace_report.py t.json [--top N]
@@ -309,6 +314,74 @@ def report(spans: List[Dict], instants: List[Dict], top: int = 10) -> str:
                        "(served entries that fell through)")
     else:
         out.append("(no per-stage data — pre-portfolio trace?)")
+
+    # 9. serve admission: the overload story — when the daemon shed or
+    # rejected on quota, and how each tenant's SLO actually landed
+    drama = sorted((e for e in instants
+                    if e["kind"] in ("shed_enter", "shed_exit",
+                                     "quota_rejected")),
+                   key=lambda e: e["t"])
+    resolved = [e for e in instants if e["kind"] == "serve_resolved"]
+    out.append("")
+    out.append("== serve admission ==")
+    if drama or resolved:
+        if drama:
+            t0 = drama[0]["t"]
+            for e in drama:
+                a = e["args"]
+                if e["kind"] == "shed_enter":
+                    out.append(
+                        f"+{e['t'] - t0:8.2f}s SHED enter "
+                        f"({a.get('reason', '?')}: depth="
+                        f"{a.get('depth', '?')} age={a.get('age', '?')})")
+                elif e["kind"] == "shed_exit":
+                    out.append(
+                        f"+{e['t'] - t0:8.2f}s shed exit (depth="
+                        f"{a.get('depth', '?')} age={a.get('age', '?')})")
+                else:
+                    out.append(
+                        f"+{e['t'] - t0:8.2f}s quota 429 tenant="
+                        f"{a.get('tenant', '?')} "
+                        f"({a.get('reason', '?')}"
+                        + (f", retry in {a['retry_after']}s"
+                           if a.get("retry_after") is not None else "")
+                        + ")")
+        else:
+            out.append("(no shed/quota events — never overloaded)")
+        if resolved:
+            per: Dict[str, Dict[str, float]] = {}
+            for e in resolved:
+                a = e["args"]
+                row = per.setdefault(str(a.get("tenant", "?")), {
+                    "n": 0, "ok": 0, "shed": 0, "evicted": 0,
+                    "error": 0, "dl_hit": 0, "dl_miss": 0,
+                    "wait": 0.0})
+                row["n"] += 1
+                status = str(a.get("status", "ok"))
+                if status in ("shed", "evicted", "error"):
+                    row[status] += 1
+                else:
+                    row["ok"] += 1
+                if a.get("deadline_hit") is True:
+                    row["dl_hit"] += 1
+                elif a.get("deadline_hit") is False:
+                    row["dl_miss"] += 1
+                w = a.get("wait")
+                if isinstance(w, (int, float)):
+                    row["wait"] += float(w)
+            out.append(f"{'tenant':<14}{'entries':>8}{'ok':>6}"
+                       f"{'shed':>6}{'evict':>6}{'err':>5}"
+                       f"{'dl-hit':>8}{'dl-miss':>8}{'mean wait':>11}")
+            for tenant in sorted(per):
+                r = per[tenant]
+                mean = r["wait"] / r["n"] if r["n"] else 0.0
+                out.append(
+                    f"{tenant:<14}{int(r['n']):>8}{int(r['ok']):>6}"
+                    f"{int(r['shed']):>6}{int(r['evicted']):>6}"
+                    f"{int(r['error']):>5}{int(r['dl_hit']):>8}"
+                    f"{int(r['dl_miss']):>8}{_fmt_s(mean):>11}")
+    else:
+        out.append("(no serve admission events — not a serve trace?)")
     return "\n".join(out)
 
 
