@@ -222,11 +222,56 @@ def bench_sweep(remaining) -> None:
             print(json.dumps({"metric": "sym_lane_steps_per_sec", "P": p,
                               "error": repr(e)[:300]}), flush=True)
             continue
+        from mythril_tpu.backend import tier_of_platform
+        plat = jax.default_backend()
         print(json.dumps({"metric": "sym_lane_steps_per_sec", "P": p,
                           "value": rec["sym_lane_steps_per_sec"],
                           "unit": "lane-steps/s",
-                          "platform": jax.default_backend(),
+                          "platform": plat,
+                          "tier": tier_of_platform(plat),
                           "extra": rec}), flush=True)
+
+
+def _run_sweep_per_tier(tiers, remaining) -> None:
+    """Run the lane-scaling sweep once per healthy tier, each in a
+    subprocess pinned to that tier's platform (the parent must stay
+    backend-free: initializing tier A's runtime here would leak into
+    tier B's child via forked state). Child records pass through
+    verbatim — they already carry platform/tier labels."""
+    import subprocess
+
+    from mythril_tpu.backend import profile
+
+    for tier in tiers:
+        if remaining() < 120:
+            print(json.dumps({"metric": "sym_lane_steps_per_sec",
+                              "tier": tier,
+                              "skipped": "budget: %.0fs left"
+                                         % remaining()}), flush=True)
+            continue
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS=profile(tier).jax_platform,
+                   MYTHRIL_BENCH_TIER=tier,     # recursion guard
+                   MYTHRIL_BENCH_NO_PROBE="1")  # the tier just probed
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True,
+                timeout=max(60.0, remaining() - 10.0), env=env)
+            out = r.stdout.strip()
+            if out:
+                print(out, flush=True)
+            else:
+                print(json.dumps({"metric": "sym_lane_steps_per_sec",
+                                  "tier": tier,
+                                  "error": "no output (rc=%s): %s"
+                                           % (r.returncode,
+                                              r.stderr[-200:])}),
+                      flush=True)
+        except Exception as e:  # one failing tier must not end the sweep
+            print(json.dumps({"metric": "sym_lane_steps_per_sec",
+                              "tier": tier, "error": repr(e)[:300]}),
+                  flush=True)
 
 
 def bench_profile(timeout_s: float = 600.0) -> dict:
@@ -283,11 +328,19 @@ def _emit(value, vs, unit_note, extra, error=None):
     with _EMIT_LOCK:
         if _EMITTED:
             return
+        # every record carries its platform + tier at top level (not
+        # buried in extra), so the perf trajectory can tell a CPU-
+        # fallback round from a hardware round without heuristics —
+        # the BENCH_r04/r05 ambiguity, fixed at the source
+        from mythril_tpu.backend import tier_of_platform
+        plat = (extra or {}).get("platform")
         rec = {
             "metric": "lane_steps_per_sec",
             "value": round(float(value), 1),
             "unit": "opcode-steps/s (%s)" % unit_note,
             "vs_baseline": round(float(vs), 2),
+            "platform": plat,
+            "tier": tier_of_platform(plat),
             # snapshot: the main thread may still be inserting keys when
             # the watchdog serializes ("dict changed size during
             # iteration" would otherwise lose the line entirely)
@@ -341,18 +394,33 @@ def _probe_backend(timeout_s: float = 75.0, retries: int = 2):
     return bm.probe()
 
 
-def _cpu_fallback(diag: str) -> None:
-    """TPU unreachable: re-run this benchmark on the CPU backend with small
-    shapes so the driver still records a parsed JSON line. The numbers are
-    labeled — a CPU-backend vectorized-vs-scalar ratio, NOT comparable to
-    TPU rounds."""
+def _tier_fallback(diag: str) -> None:
+    """Configured backend unreachable: walk the ranked tier ladder
+    (mythril_tpu/backend.py) to the first lower tier that probes
+    healthy and re-run this benchmark there with small shapes, so the
+    driver still records a parsed JSON line. The numbers are labeled
+    with the fallback tier — NOT comparable to preferred-tier rounds."""
     import subprocess
 
+    from mythril_tpu.backend import (probe_tier, profile, terminal_tier,
+                                     tiers_below)
+    from mythril_tpu.resilience import BackendManager
+
     here = os.path.dirname(os.path.abspath(__file__))
+    configured = BackendManager._configured_tier()
+    tier = terminal_tier()
+    for cand in tiers_below(configured):
+        if cand == terminal_tier():
+            break  # the floor is trusted, not probed
+        ok, _ = probe_tier(cand, timeout_s=30.0)
+        if ok:
+            tier = cand
+            break
     env = dict(os.environ)
-    # concrete only: sym_run/fire_lasers XLA compiles take minutes on a CPU
-    # backend and would blow the driver's remaining time budget
-    env.update(JAX_PLATFORMS="cpu", MYTHRIL_BENCH_SMALL="1",
+    # concrete only: sym_run/fire_lasers XLA compiles take minutes on a
+    # fallback backend and would blow the driver's remaining time budget
+    env.update(JAX_PLATFORMS=profile(tier).jax_platform,
+               MYTHRIL_BENCH_SMALL="1",
                MYTHRIL_BENCH_NO_PROBE="1", MYTHRIL_BENCH_NO_PROFILE="1",
                MYTHRIL_BENCH_NO_ANALYZE="1", MYTHRIL_BENCH_NO_SYM="1")
     try:
@@ -360,7 +428,7 @@ def _cpu_fallback(diag: str) -> None:
                            capture_output=True, text=True, timeout=360, env=env)
         rec = json.loads(r.stdout.strip().splitlines()[-1])
         extra = rec.get("extra", {})
-        extra["platform"] = "cpu-fallback"
+        extra["platform"] = "%s-fallback" % tier
         extra["tpu_error"] = diag[:300]
         # the most recent chip measurements (tools/profile_superstep.py
         # writes them on every headline-config TPU run), so a
@@ -376,16 +444,17 @@ def _cpu_fallback(diag: str) -> None:
         except (OSError, ValueError, AttributeError, TypeError):
             pass  # optional decoration must never sink the record itself
         _emit(rec.get("value", 0.0), rec.get("vs_baseline", 0.0),
-              "CPU-FALLBACK " + rec.get("unit", ""), extra,
-              error="tpu backend unavailable: " + diag)
+              "%s-FALLBACK %s" % (tier.upper(), rec.get("unit", "")),
+              extra, error="configured backend unavailable: " + diag)
     except Exception as e:
         _emit(0.0, 0.0, "no backend", {"tpu_error": diag[:300]},
-              error="tpu unavailable (%s); cpu fallback also failed: %r"
-                    % (diag[:200], e))
+              error="backend unavailable (%s); %s fallback also failed: "
+                    "%r" % (diag[:200], tier, e))
 
 
 def main():
     global P, MAX_STEPS, SYM_P, SYM_MAX_STEPS, ANALYZE_CONTRACTS
+    global _EMITTED
     if os.environ.get("MYTHRIL_BENCH_SMALL"):
         P, MAX_STEPS, SYM_P, SYM_MAX_STEPS = 1024, 192, 1024, 128
         ANALYZE_CONTRACTS = 8
@@ -405,7 +474,24 @@ def main():
     if not os.environ.get("MYTHRIL_BENCH_NO_PROBE"):
         ok, diag = _probe_backend()
         if not ok:
-            _cpu_fallback(diag)
+            _tier_fallback(diag)
+            return
+
+    if (os.environ.get("BENCH_SWEEP")
+            and not os.environ.get("MYTHRIL_BENCH_TIER")):
+        # per-tier sweep (docs/resilience.md "Backend tiers"): when
+        # more than one tier probes healthy, re-run the sweep once per
+        # tier in a pinned subprocess so the perf trajectory gets a
+        # labeled P-curve per platform. One healthy tier (the common
+        # CPU-only box) falls straight through to the in-process sweep.
+        from mythril_tpu.backend import available_tiers
+
+        tiers = available_tiers()
+        if len(tiers) > 1:
+            _run_sweep_per_tier(tiers, remaining)
+            sw.stop()
+            with _EMIT_LOCK:
+                _EMITTED = True
             return
 
     _lazy_imports()
@@ -413,7 +499,6 @@ def main():
         # lane-scaling sweep mode: per-P records instead of the single
         # headline line; suppress the watchdog's error-shaped emit —
         # the sweep's own records are the output
-        global _EMITTED
         bench_sweep(remaining)
         sw.stop()
         with _EMIT_LOCK:

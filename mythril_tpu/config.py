@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from .backend import default_oom_ladder
+
 
 @dataclass(frozen=True)
 class LimitsConfig:
@@ -67,12 +69,21 @@ class ResilienceConfig:
     probe_backoff: float = 5.0          # seconds between probe attempts
     # RESOURCE_EXHAUSTED degradation ladder, walked in order and
     # cumulatively (see resilience.DEGRADE_RUNGS / docs/resilience.md):
-    # shrink the work until the batch fits instead of aborting the run
-    oom_ladder: tuple = ("halve-lanes", "halve-batch", "cpu")
+    # shrink the work until the batch fits instead of aborting the run.
+    # The shape comes from the BackendProfile registry; the terminal
+    # rung means "demote to the next available tier", not "pin to CPU"
+    oom_ladder: tuple = default_oom_ladder()
     # batches between durable campaign-checkpoint writes (1 = every
     # batch — kill -9 at any instant loses at most one batch; larger
     # values trade replayed batches for less checkpoint I/O)
     checkpoint_every: int = 1
+    # --- backend tiers (mythril_tpu/backend.py, docs/resilience.md
+    # "Backend tiers"): the demote-and-repromote failover ladder
+    backend_tiers: tuple | None = None   # ranked tier names; None = detect
+    tier_probe_every: float = 30.0       # s between re-promotion probes
+    tier_sticky_window: float = 20.0     # s a fresh demotion must hold
+    tier_flap_window: float = 120.0      # rolling window for flap damping
+    tier_flap_max: int = 4               # max transitions per flap window
 
 
 DEFAULT_RESILIENCE = ResilienceConfig()

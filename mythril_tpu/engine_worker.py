@@ -222,7 +222,11 @@ def _run_batch(camp, stub: bool, msg: Dict,
             fault.fire("mid-superstep", nth)
         return {"issues": [], "paths": len(names), "dropped": 0,
                 "iprof": {}}
-    cm = camp._cpu_device() if msg.get("on_cpu") else None
+    # tier pin: honor the explicit tier label when present (a demoted
+    # parent pins degraded batches to its tier), else the historical
+    # on_cpu bool from older supervisors
+    tier = msg.get("on_tier") or ("cpu" if msg.get("on_cpu") else None)
+    cm = camp._tier_device(tier) if tier else None
     with (cm if cm is not None else contextlib.nullcontext()):
         sym = camp._explore_batch(bi, names, codes, lanes, width)
         if fault is not None:

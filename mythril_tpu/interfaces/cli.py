@@ -245,6 +245,15 @@ def create_parser() -> argparse.ArgumentParser:
                         "that pins work to the in-process CPU path "
                         "(docs/resilience.md). auto (default) = on "
                         "under --fleet, off otherwise")
+    a.add_argument("--backend-tiers", metavar="LIST", default=None,
+                   help="campaign mode: ranked backend-tier ladder "
+                        "(comma-separated from 'tpu', 'gpu', 'cpu'; "
+                        "default: detect from the environment). A "
+                        "crash-looping or lost backend DEMOTES to the "
+                        "next tier instead of pinning to CPU, and a "
+                        "background prober re-promotes when the "
+                        "better tier probes healthy again "
+                        "(docs/resilience.md \"Backend tiers\")")
     a.add_argument("--fleet-follow", action="store_true",
                    help="fleet mode: join a serve daemon's FEED ledger "
                         "(docs/serving.md) — units carry their own "
@@ -465,6 +474,14 @@ def create_parser() -> argparse.ArgumentParser:
                          "pins the config to in-process CPU — "
                          "reported in /healthz degraded_configs "
                          "(docs/resilience.md)")
+    sv.add_argument("--backend-tiers", metavar="LIST", default=None,
+                    help="ranked backend-tier ladder for resident "
+                         "campaigns (comma-separated from 'tpu', "
+                         "'gpu', 'cpu'; default: detect). Each config "
+                         "is a capacity class placed on whatever tier "
+                         "its worker holds; demotions/re-promotions "
+                         "surface in /healthz backend_tiers and the "
+                         "engine_tier_* metrics (docs/serving.md)")
     sv.add_argument("--trace", metavar="FILE",
                     help="Chrome-trace + JSONL event log (admit/"
                          "queue_wait/schedule/stream spans ride the "
@@ -792,7 +809,9 @@ def _exec_campaign(args) -> int:
     """Corpus campaign: BASELINE configs 2-3 (SURVEY §6), supervised by
     the resilience layer (watchdog + quarantine + backend fallback)."""
     import json
+    import os
 
+    from ..backend import parse_tiers
     from ..config import DEFAULT_RESILIENCE
     from ..resilience import BackendManager, FaultInjector, parse_ladder
 
@@ -806,16 +825,24 @@ def _exec_campaign(args) -> int:
     # wedged TPU runtime hangs jax.devices() forever (docs/
     # tpu-wedge-round5.md); the probe wedges a subprocess instead, and
     # the campaign degrades to the CPU backend with the event on record
+    try:
+        backend_tiers = (parse_tiers(args.backend_tiers)
+                         if args.backend_tiers else None)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(2)
+
     backend = None
     if args.init_timeout is not None:
         backend = BackendManager(
             init_timeout=args.init_timeout,
             max_attempts=DEFAULT_RESILIENCE.probe_attempts,
             backoff=DEFAULT_RESILIENCE.probe_backoff)
-        ok, diag = backend.ensure_or_fallback()
+        ok, diag = backend.ensure_or_fallback(tiers=backend_tiers)
         if not ok:
+            landed = os.environ.get("JAX_PLATFORMS", "cpu")
             print(f"warning: backend unavailable ({diag}); continuing "
-                  "on the CPU backend", file=sys.stderr)
+                  f"on the {landed} backend", file=sys.stderr)
 
     from ..mythril.campaign import CorpusCampaign, load_corpus_dir
     from ..symbolic import SymSpec
@@ -887,6 +914,7 @@ def _exec_campaign(args) -> int:
         solver_store=(None if args.no_solver_store
                       else (args.solver_store or "auto")),
         worker_isolation=args.worker_isolation,
+        backend_tiers=backend_tiers,
     )
 
     unit_word = "unit" if args.fleet else "batch"
@@ -916,6 +944,10 @@ def exec_serve(args) -> int:
 
     try:
         oom_ladder = parse_ladder(args.oom_ladder)
+        if args.backend_tiers:
+            from ..backend import parse_tiers
+
+            parse_tiers(args.backend_tiers)  # fail fast on unknown tiers
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         raise SystemExit(2)
@@ -960,6 +992,7 @@ def exec_serve(args) -> int:
         fault_inject=args.fault_inject,
         concrete_storage=args.concrete_storage,
         worker_isolation=args.worker_isolation,
+        backend_tiers=args.backend_tiers,
     )
     daemon = AnalysisDaemon(
         opts, data_dir=args.data_dir, host=args.host, port=args.port,

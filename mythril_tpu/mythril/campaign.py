@@ -218,6 +218,8 @@ class CorpusCampaign:
         solver_store: Optional[str] = "auto",
         worker_isolation: str = "off",
         worker_supervisor=None,
+        tier_manager=None,
+        backend_tiers: Optional[Sequence[str]] = None,
     ):
         # multi-host corpus sharding (SURVEY §5.8: "host-side DCN ... only
         # for corpus sharding"): each host takes a deterministic strided
@@ -394,6 +396,25 @@ class CorpusCampaign:
         if worker_supervisor is not None \
                 and worker_supervisor.on_event is None:
             worker_supervisor.on_event = self._worker_event
+        # backend tiers (mythril_tpu/backend.py, docs/resilience.md
+        # "Backend tiers"): the demote-and-repromote failover ladder.
+        # Lazy — no TierManager exists until the first demotion-capable
+        # failure (crash-loop breaker, device loss), so tier-free runs
+        # pay nothing; an EXPLICIT ladder (backend_tiers / injected
+        # manager) is created eagerly so the tier shows up as a
+        # capacity class (serve /healthz, heartbeat) while healthy. An
+        # injected manager may be shared across campaigns (the serve
+        # scheduler, soak); only an owned one has its prober stopped
+        # at run end.
+        self._tm = tier_manager
+        self._tm_owned = tier_manager is None
+        self._backend_tiers = backend_tiers
+        self._tier_gen_seen = (tier_manager.generation
+                               if tier_manager is not None else 0)
+        if tier_manager is not None and tier_manager.on_event is None:
+            tier_manager.on_event = self._tier_event
+        elif tier_manager is None and backend_tiers is not None:
+            self._tier_manager()
 
     # --- checkpointing -------------------------------------------------
     @property
@@ -680,22 +701,30 @@ class CorpusCampaign:
         if self._supervisor is None:
             from ..resilience import WorkerSupervisor
 
+            # spawn the worker pinned to the tier this campaign holds
+            # (empty overlay when no ladder is active or env pinning is
+            # off): the worker is the tier's capacity, so a demoted
+            # campaign's replacement worker must come up on the demoted
+            # platform, not re-wedge on the failed one
+            worker_env = (self._tm.platform_env()
+                          if self._tm is not None else {})
             self._supervisor = WorkerSupervisor(
                 config=self._worker_config(),
                 batch_timeout=self.batch_timeout,
                 fault_injector=self.fault_injector,
-                on_event=self._worker_event)
+                on_event=self._worker_event,
+                worker_env=worker_env)
         return self._supervisor
 
     def _worker_run(self, bi: int, names: List[str], codes: List[bytes],
                     lanes: Optional[int], width: Optional[int],
-                    on_cpu: bool) -> Dict:
+                    on_tier: Optional[str]) -> Dict:
         """One batch through the supervisor (which enforces the
         per-batch deadline parent-side — no extra watchdog thread).
         Success marks the shape class worker-warm."""
         sup = self._ensure_supervisor()
         out = sup.run_batch(bi, names, codes, lanes=lanes, width=width,
-                            on_cpu=on_cpu)
+                            on_cpu=(on_tier == "cpu"), on_tier=on_tier)
         self._warm_set(lanes, width).add(_WORKER_WARM)
         return out
 
@@ -706,6 +735,14 @@ class CorpusCampaign:
         if self._supervisor is None:
             return None
         return self._supervisor.status()
+
+    def tier_status(self) -> Optional[Dict]:
+        """Backend-tier ladder state (current/preferred tier, demotion
+        and re-promotion counts, flap damping) for ``serve``
+        ``/healthz``; None while no ladder has been needed."""
+        if self._tm is None:
+            return None
+        return self._tm.status()
 
     def close_worker(self) -> None:
         """Shut the engine worker down (run() exit, serve drain). The
@@ -762,37 +799,121 @@ class CorpusCampaign:
 
     # --- fault isolation ----------------------------------------------
     @staticmethod
-    def _cpu_device():
-        """``jax.default_device`` context pinning execution to the host
-        CPU backend, or None when no CPU device is available (then the
-        rung degenerates to a plain replay). Imported lazily — the
-        campaign must stay importable without initializing a backend."""
+    def _tier_device(platform: str = "cpu"):
+        """``jax.default_device`` context pinning execution to the
+        given tier's platform, or None when no such device is available
+        (then the pin degenerates to a plain replay). Imported lazily —
+        the campaign must stay importable without initializing a
+        backend."""
         try:
             import jax
 
-            return jax.default_device(jax.devices("cpu")[0])
-        except Exception:  # noqa: BLE001 — no backend / no cpu plugin
+            from ..backend import profile as _tier_profile
+
+            try:
+                platform = _tier_profile(platform).jax_platform
+            except ValueError:
+                pass  # raw jax platform label (e.g. "cuda") — use as is
+            return jax.default_device(jax.devices(platform)[0])
+        except Exception:  # noqa: BLE001 — no backend / no such plugin
             return None
+
+    @classmethod
+    def _cpu_device(cls):
+        """Historical name for the floor-tier pin (kept for the engine
+        worker's compat path)."""
+        return cls._tier_device("cpu")
+
+    # --- backend tiers (docs/resilience.md "Backend tiers") -------------
+    def _tier_event(self, kind: str, detail: str = "", **kw) -> None:
+        """TierManager events routed onto the campaign's event stream
+        (report ``backend_events`` + trace bus + counters)."""
+        self._event(kind, detail=detail, **kw)
+
+    def _tier_manager(self):
+        """Get-or-create the tier ladder. Created on the first
+        demotion-capable failure with knobs from DEFAULT_RESILIENCE;
+        the detected tier list on a pinned process is just the pinned
+        platform plus the floor, so a CPU-only run's ladder is
+        ``("cpu",)`` and every demotion is a silent floor no-op."""
+        if self._tm is None:
+            from ..backend import TierManager
+
+            self._tm = TierManager(
+                tiers=self._backend_tiers,
+                sticky_window=DEFAULT_RESILIENCE.tier_sticky_window,
+                flap_window=DEFAULT_RESILIENCE.tier_flap_window,
+                flap_max=DEFAULT_RESILIENCE.tier_flap_max,
+                probe_every=DEFAULT_RESILIENCE.tier_probe_every,
+                on_event=self._tier_event)
+            self._tier_gen_seen = self._tm.generation
+        return self._tm
+
+    def _tier_sync(self) -> Optional[str]:
+        """Fold tier transitions — possibly applied by the background
+        prober thread — into campaign state at a batch-attempt
+        boundary, the one place it is safe: every warm-shape marker is
+        invalidated (the cached executables belong to the previous
+        backend) and the engine worker is closed so the next dispatch
+        respawns it pinned to the new tier (fresh process, fresh
+        crash-loop breaker — the crash evidence belonged to the old
+        tier). Returns the tier to pin this attempt to, or None while
+        the preferred tier holds."""
+        tm = self._tm
+        if tm is None:
+            return None
+        if tm.generation != self._tier_gen_seen:
+            self._tier_gen_seen = tm.generation
+            for s in self._warm_shapes.values():
+                s.clear()
+            self.close_worker()
+            self._event("tier_applied", tier=tm.current,
+                        generation=tm.generation)
+        return tm.current if tm.demoted() else None
+
+    def _floor_tier(self) -> str:
+        """The tier the terminal OOM-ladder rung lands on: the worst
+        rung of this campaign's ladder (the floor — host CPU — when no
+        ladder exists yet)."""
+        if self._tm is not None:
+            return self._tm.tiers[-1]
+        from ..backend import terminal_tier
+
+        return terminal_tier()
 
     def _guarded_batch(self, bi: int, items: Sequence[tuple],
                        lanes: Optional[int] = None,
                        width: Optional[int] = None,
-                       on_cpu: bool = False) -> Dict:
+                       on_cpu: bool = False,
+                       on_tier: Optional[str] = None) -> Dict:
         """One attempt: fault-injection check + engine pass, under the
         wall-clock watchdog. A hung compile / wedged device call
         surfaces as BatchTimeout here instead of stalling the run.
-        ``lanes``/``width``/``on_cpu`` carry the degradation rung.
+        ``lanes``/``width``/``on_tier`` carry the degradation rung
+        (``on_cpu`` is the rung's historical spelling: the floor tier).
 
         With worker isolation on, the pass runs in the supervised
         engine-worker subprocess instead: the supervisor enforces the
         same ``batch_timeout`` from the parent side (so no watchdog
         thread is layered on top), a worker death raises
         ``WorkerDied`` into the same retry→ladder→bisect tail, and an
-        open crash-loop breaker pins the attempt to the in-process CPU
-        path — the one backend the accelerator crash loop cannot
-        reach."""
+        open crash-loop breaker DEMOTES the backend tier — the attempt
+        falls through to the in-process path on the demoted tier, and
+        the tier manager's prober climbs back when the better tier
+        probes healthy again (no permanent pin)."""
         names = [n for n, _ in items]
         codes = [c for _, c in items]
+
+        # batch boundaries are where tier transitions land: give a due
+        # re-promotion its chance, then fold any transition (from here
+        # or the background prober) into campaign state
+        if self._tm is not None:
+            self._tm.tick()
+        pin = self._tier_sync()
+        if on_cpu and on_tier is None:
+            on_tier = self._floor_tier()
+        if on_tier is None and pin is not None:
+            on_tier = pin
 
         injected = False
         if self._worker_enabled():
@@ -807,11 +928,17 @@ class CorpusCampaign:
                 injected = True
             try:
                 return self._worker_run(bi, names, codes, lanes, width,
-                                        on_cpu)
+                                        on_tier)
             except WorkerCrashLoop as e:
+                tm = self._tier_manager()
+                on_tier = tm.demote(
+                    reason=f"worker crash-loop: {str(e)[:160]}")
                 self._event("worker_breaker_pinned", batch=bi,
-                            detail=str(e)[:200])
-                on_cpu = True  # fall through to the in-process path
+                            tier=on_tier, detail=str(e)[:200])
+                # consume the transition now (close the dead worker,
+                # drop warm markers) and finish this attempt in-process
+                # on the demoted tier
+                self._tier_sync()
 
         def call_runner():
             runner = self._batch_runner or self._exec_batch
@@ -822,8 +949,8 @@ class CorpusCampaign:
         def work():
             if self.fault_injector is not None and not injected:
                 self.fault_injector.fire(batch=bi, contracts=names)
-            if on_cpu:
-                cm = self._cpu_device()
+            if on_tier is not None:
+                cm = self._tier_device(on_tier)
                 if cm is not None:
                     with cm:
                         return call_runner()
@@ -897,8 +1024,14 @@ class CorpusCampaign:
     def _note_failure(self, e: BaseException) -> None:
         # a device loss gets a bounded backend re-probe (with backoff)
         # before the batch retries; the events land in the report
-        if isinstance(e, DeviceLostError) and self.backend is not None:
-            self.backend.recover(reason=str(e)[:200])
+        if isinstance(e, DeviceLostError):
+            if self.backend is not None:
+                self.backend.recover(reason=str(e)[:200])
+            # losing the device is the tier's failure: when a ladder is
+            # active, demote so the retry runs on the next tier (a
+            # CPU-only ladder makes this a silent floor no-op)
+            if self._tm is not None:
+                self._tm.demote(reason=f"device-lost: {str(e)[:160]}")
 
     def _degrade_batch(self, bi: int, items: Sequence[tuple],
                        first_err: BaseException) -> Tuple[Dict, str]:
@@ -907,7 +1040,8 @@ class CorpusCampaign:
         Rungs apply cumulatively — halve the per-contract lanes, then
         additionally halve the batch width (the batch replays as
         half-width sub-batches, each padded to the new shape), then
-        additionally pin execution to the CPU backend. Every step lands
+        additionally demote execution to the next available backend
+        tier (host CPU on the floor). Every step lands
         in the report's ``backend_events``; a rung that fails with a
         NON-OOM error re-raises immediately (that failure belongs to
         the retry/bisect machinery, not the ladder). Partial sub-batch
@@ -917,7 +1051,7 @@ class CorpusCampaign:
         last OOM when the ladder is exhausted."""
         lanes = self.lanes_per_contract
         width = self.batch_size
-        on_cpu = False
+        on_tier: Optional[str] = None
         err = first_err
         for rung in self.oom_ladder:
             if rung == "halve-lanes":
@@ -925,7 +1059,10 @@ class CorpusCampaign:
             elif rung == "halve-batch":
                 width = max(1, width // 2)
             elif rung == "cpu":
-                on_cpu = True
+                # the terminal rung's historical name: demote this
+                # batch to the ladder's floor tier (host CPU when no
+                # lower accelerator tier is configured)
+                on_tier = self._floor_tier()
             self._event("degrade", detail=self._fault_reason(err),
                         batch=bi, step=rung, lanes=lanes, width=width)
             try:
@@ -933,7 +1070,7 @@ class CorpusCampaign:
                 for k in range(0, len(items), width):
                     r = self._guarded_batch(bi, items[k:k + width],
                                             lanes=lanes, width=width,
-                                            on_cpu=on_cpu)
+                                            on_tier=on_tier)
                     out["issues"].extend(r["issues"])
                     out["paths"] += r["paths"]
                     out["dropped"] += r["dropped"]
@@ -1103,10 +1240,16 @@ class CorpusCampaign:
             wk = f" wkr r{wst['restarts']}"
             if wst["breaker"] != "closed":
                 wk += f"/breaker-{wst['breaker']}"
+        # backend-tier token: which capacity class this campaign holds
+        # right now ("tier=cpu!" marks a demotion in one glance)
+        tier = self._tm.current if self._tm is not None else None
+        tk = ""
+        if tier is not None:
+            tk = f" tier={tier}" + ("!" if self._tm.demoted() else "")
         print(f"heartbeat: batch {done}/{total} contracts {contracts}/"
               f"{len(self.contracts)} paths/s {pps:.1f} frontier "
               f"{100.0 * occ:.0f}% rung {rung} z3-avoid {z3av:.0f}% "
-              f"ckpt-age {age_s}{wk}",
+              f"ckpt-age {age_s}{wk}{tk}",
               file=sys.stderr, flush=True)
         obs_trace.event("heartbeat", batch=done, batches_total=total,
                         contracts=contracts,
@@ -1118,7 +1261,8 @@ class CorpusCampaign:
                         worker_restarts=(wst["restarts"]
                                          if wst is not None else None),
                         worker_breaker=(wst["breaker"]
-                                        if wst is not None else None))
+                                        if wst is not None else None),
+                        tier=tier)
 
     # --- the pipelined loop --------------------------------------------
     def _run_pipelined(self, start_batch: int, n_batches: int,
@@ -1502,6 +1646,11 @@ class CorpusCampaign:
             # SIGKILL of this process closes the pipes instead, and
             # the worker exits on stdin EOF)
             self.close_worker()
+            # an OWNED tier ladder's prober dies with the run; an
+            # injected (shared) one keeps probing — the serve
+            # scheduler / soak harness owns its lifecycle
+            if self._tm is not None and self._tm_owned:
+                self._tm.stop_prober()
         res.solver_portfolio = smt_portfolio.stats_delta(
             smt_portfolio.PORTFOLIO_STATS.snapshot(), self._pstats0)
         return res

@@ -47,6 +47,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from .backend import (TIER_ORDER, TIER_RUNG, TIER_RUNG_ALIAS,
+                      default_oom_ladder, profile as tier_profile,
+                      probe_tier, terminal_tier, tiers_below)
+
 
 class ResilienceError(RuntimeError):
     """Base for supervisor-level failures."""
@@ -186,16 +190,22 @@ def classify_backend_error(e: BaseException) -> Optional[str]:
 #: cumulatively: halve the per-contract frontier lanes (displaced forks
 #: park and spill through the engine's defer/rebalance machinery), then
 #: additionally halve the batch width (two half-width sub-batches), then
-#: additionally pin execution to the CPU backend (host RAM >> HBM)
-DEGRADE_RUNGS = ("halve-lanes", "halve-batch", "cpu")
+#: additionally demote execution to the next available backend tier
+#: (host RAM >> HBM on the floor). The ladder shape is owned by the
+#: BackendProfile registry; the terminal rung keeps its historical name
+#: ``"cpu"`` but is resolved against the tier ladder at walk time.
+DEGRADE_RUNGS = default_oom_ladder()
 
 
 def parse_ladder(text: Optional[str]) -> Tuple[str, ...]:
     """``--oom-ladder`` parser: comma-separated rung names in walk
-    order; ``"none"`` (or empty) disables degradation entirely."""
+    order; ``"none"`` (or empty) disables degradation entirely. The
+    terminal rung accepts both its historical spelling (``cpu``) and
+    ``next-tier``; both mean "demote to the next available tier"."""
     if text is None:
         return DEGRADE_RUNGS
-    rungs = tuple(r.strip() for r in text.split(",") if r.strip())
+    rungs = tuple(TIER_RUNG if r.strip() == TIER_RUNG_ALIAS else r.strip()
+                  for r in text.split(",") if r.strip())
     if rungs in ((), ("none",)):
         return ()
     for r in rungs:
@@ -208,7 +218,7 @@ def parse_ladder(text: Optional[str]) -> Tuple[str, ...]:
 # --- fault injection --------------------------------------------------
 
 FAULT_MODES = ("hang", "raise", "device-lost", "kill", "oom",
-               "worker-kill", "worker-segv")
+               "worker-kill", "worker-segv", "flap")
 
 #: fault modes handled by the WorkerSupervisor (a signal is delivered
 #: to the engine worker SUBPROCESS) rather than raised in-process by
@@ -229,7 +239,14 @@ class FaultSpec:
     retry-once policy cures). ``nth=N`` instead fires on the Nth
     matching attempt seen by THIS process (1-based) — worker-LOCAL
     ordering, for fleet tests where global batch indices are claimed
-    nondeterministically across racing workers (docs/fleet.md)."""
+    nondeterministically across racing workers (docs/fleet.md).
+
+    ``flap`` models an oscillating backend: odd matching attempts lose
+    the device (:class:`DeviceLostError`, which demotes the campaign's
+    backend tier), even attempts pass — so demote, repromote, demote
+    alternate deterministically until flap damping holds the tier
+    (docs/resilience.md "Backend tiers"). ``times`` bounds the number
+    of down-phases; only down-phases count as fires."""
 
     mode: str
     batch: Optional[int] = None
@@ -238,6 +255,7 @@ class FaultSpec:
     nth: Optional[int] = None
     fired: int = 0
     calls: int = 0
+    flap_calls: int = 0
 
     def matches(self, batch: Optional[int],
                 contracts: Sequence[str]) -> bool:
@@ -282,7 +300,9 @@ class FaultSpec:
             else:
                 raise ValueError(f"fault spec {text!r}: unknown key {k!r}")
         if spec.batch is None and spec.contract is None \
-                and spec.nth is None:
+                and spec.nth is None and spec.mode != "flap":
+            # flap is exempt: its down/up alternation IS its bound —
+            # every even attempt passes, so it cannot poison a batch
             raise ValueError(
                 f"fault spec {text!r}: need batch=, contract= and/or "
                 "nth= (an unconditional fault would poison every batch)")
@@ -321,6 +341,19 @@ class FaultInjector:
                 continue
             if not spec.matches(batch, contracts):
                 continue
+            if spec.mode == "flap":
+                # oscillation: odd matching attempts are the down-phase
+                # (device lost), even attempts the up-phase (clean pass
+                # — and other specs still get their look)
+                spec.flap_calls += 1
+                if spec.flap_calls % 2 == 0:
+                    continue
+                spec.fired += 1
+                self.log.append({"mode": "flap", "batch": batch,
+                                 "contracts": list(contracts)})
+                raise DeviceLostError(
+                    f"injected flapping backend: device lost "
+                    f"(batch={batch}, down-phase {spec.fired})")
             spec.fired += 1
             self.log.append({"mode": spec.mode, "batch": batch,
                              "contracts": list(contracts)})
@@ -450,18 +483,60 @@ class BackendManager:
                 time.sleep(self.backoff * attempt)
         return False, diag
 
-    def ensure_or_fallback(self) -> Tuple[bool, str]:
-        """Probe; on failure pin this process to the CPU backend via
-        JAX_PLATFORMS (heavy engine imports must not have run yet) and
-        record an explicit ``cpu_fallback`` event. Returns
-        (backend_ok, diagnosis)."""
+    def ensure_or_fallback(self, tiers: Optional[Sequence[str]] = None
+                           ) -> Tuple[bool, str]:
+        """Probe the configured tier; on failure walk DOWN the ranked
+        tier ladder (mythril_tpu.backend) probing each lower tier once,
+        and pin this process — via JAX_PLATFORMS, so heavy engine
+        imports must not have run yet — to the first tier that answers.
+        The floor tier (host CPU) needs no probe and is where the walk
+        always terminates; landing there records the historical
+        ``cpu_fallback`` event kind, landing on an intermediate tier
+        records ``tier_fallback``. Returns (backend_ok, diagnosis) for
+        the *configured* backend."""
         ok, diag = self.probe()
         if ok:
             return True, diag
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        self._event("cpu_fallback",
-                    "configured backend unreachable; JAX_PLATFORMS=cpu")
+        configured = self._configured_tier()
+        landed = None
+        for tier in tiers_below(configured, tiers):
+            if tier == terminal_tier():
+                break  # the floor is trusted, not probed
+            if self.probe_fn is not None:
+                tok, tdiag = self.probe_fn(tier_profile(tier).probe_timeout)
+            else:
+                tok, tdiag = probe_tier(tier)
+            self._event("probe_ok" if tok else "probe_fail",
+                        f"tier {tier}: {tdiag}")
+            if tok:
+                landed = tier
+                break
+        if landed is None:
+            landed = terminal_tier()
+        os.environ["JAX_PLATFORMS"] = tier_profile(landed).jax_platform
+        kind = ("cpu_fallback" if landed == terminal_tier()
+                else "tier_fallback")
+        self._event(kind,
+                    f"configured backend ({configured}) unreachable; "
+                    f"demoted to the {landed} tier "
+                    f"(JAX_PLATFORMS={tier_profile(landed).jax_platform})")
         return False, diag
+
+    @staticmethod
+    def _configured_tier() -> str:
+        """The tier this process was asked to run on: a pinned
+        JAX_PLATFORMS if it names a known tier, else the best rank
+        (an unpinned process is assumed to want the best hardware)."""
+        pinned = os.environ.get("JAX_PLATFORMS", "")
+        for part in pinned.split(","):
+            tier = part.strip().lower()
+            if tier == "cuda":
+                tier = "gpu"
+            try:
+                return tier_profile(tier).name
+            except ValueError:
+                continue
+        return TIER_ORDER[0]
 
     def recover(self, reason: str = "device-lost") -> bool:
         """After a device loss mid-campaign: record it, re-probe with the
@@ -810,7 +885,8 @@ class WorkerSupervisor:
                   codes: Sequence[bytes],
                   lanes: Optional[int] = None,
                   width: Optional[int] = None,
-                  on_cpu: bool = False) -> Dict:
+                  on_cpu: bool = False,
+                  on_tier: Optional[str] = None) -> Dict:
         """Run one batch in the worker under the parent-side deadline.
         Raises :class:`WorkerCrashLoop` (breaker open),
         :class:`BatchTimeout` (deadline; worker killed),
@@ -836,7 +912,8 @@ class WorkerSupervisor:
                             "names": [str(x) for x in names],
                             "codes": [bytes(c) for c in codes],
                             "lanes": lanes, "width": width,
-                            "on_cpu": bool(on_cpu)})
+                            "on_cpu": bool(on_cpu or on_tier == "cpu"),
+                            "on_tier": on_tier})
                 rep = self._read_frame(deadline)
             except TimeoutError:
                 self._record_death(

@@ -71,6 +71,12 @@ class ServeOptions:
     #: dedupe config hash): flipping it must not split the verdict
     #: cache.
     worker_isolation: str = "auto"
+    #: ranked backend-tier ladder for resident campaigns (comma string
+    #: or sequence; None = detect — docs/resilience.md "Backend
+    #: tiers"). Operational like worker_isolation: which tier served a
+    #: batch must not split the verdict cache — the issues in the
+    #: bytecode don't depend on the silicon that found them.
+    backend_tiers: Optional[Sequence[str]] = None
     #: per-request overrides accepted in the submit body's ``options``
     OVERRIDABLE = ("max_steps", "transaction_count", "modules")
 
@@ -107,6 +113,10 @@ class ServeOptions:
             "fault_inject": self.fault_inject,
             "concrete_storage": self.concrete_storage,
             "worker_isolation": self.worker_isolation,
+            "backend_tiers": (tuple(self.backend_tiers)
+                              if isinstance(self.backend_tiers,
+                                            (list, tuple))
+                              else self.backend_tiers),
         }
         return cfg
 
@@ -203,6 +213,12 @@ class AnalysisDaemon:
         degraded = self.scheduler.degraded_configs()
         if degraded:
             doc["degraded_configs"] = degraded
+        # backend-tier capacity classes (docs/resilience.md "Backend
+        # tiers"): per-config ladder state, present once any resident
+        # campaign has needed a ladder
+        tiers = self.scheduler.tier_status()
+        if tiers:
+            doc["backend_tiers"] = tiers
         if self.follower is not None:
             doc["follower"] = self.follower.status()
         return doc

@@ -89,6 +89,12 @@ def default_campaign_factory(config: Dict):
         oom_ladder=config.get("oom_ladder"),
         solver_workers=int(config.get("solver_workers", 1)),
         worker_isolation=isolation,
+        # backend tiers (docs/resilience.md "Backend tiers"): each
+        # resident campaign is placed on whatever tier its worker
+        # currently holds — a crash-looping accelerator demotes just
+        # this config's capacity class, and the ladder's prober climbs
+        # back without a daemon restart
+        backend_tiers=config.get("backend_tiers"),
     )
 
 
@@ -386,6 +392,22 @@ class Scheduler:
             if st is not None:
                 n += int(st.get("restarts", 0))
         return n
+
+    # --- backend-tier surface (docs/resilience.md "Backend tiers") ------
+    def tier_status(self) -> List[Dict]:
+        """Per-config backend-tier ladder state: which capacity class
+        each resident campaign currently holds, plus its demotion /
+        re-promotion / flap-damping accounting. ``/healthz`` reports it
+        so an orchestrator can see "config X runs demoted on cpu, the
+        prober is climbing" without grepping logs."""
+        out: List[Dict] = []
+        for cfh, camp in list(self._campaigns.items()):
+            status = getattr(camp, "tier_status", None)
+            st = status() if callable(status) else None
+            if st is not None:
+                st["config"] = cfh
+                out.append(st)
+        return out
 
 
 __all__ = ["Scheduler", "default_campaign_factory"]

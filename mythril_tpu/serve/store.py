@@ -58,7 +58,8 @@ def bytecode_hash(code: bytes) -> str:
 #: changes which paths survive.
 OPERATIONAL_KEYS = frozenset((
     "fault_inject", "batch_timeout", "max_batch_retries", "oom_ladder",
-    "solver_workers", "batch_size", "worker_isolation"))
+    "solver_workers", "batch_size", "worker_isolation",
+    "backend_tiers"))
 
 
 def config_hash(config: Dict) -> str:

@@ -1,0 +1,317 @@
+"""Backend tier ladder (mythril_tpu/backend.py).
+
+The profile registry owns each platform's constants; the TierManager
+owns the demote-and-repromote state machine that replaced the old
+permanent "pin to CPU": a crash-loop or device loss steps DOWN one
+tier, a background probe of the better tier climbs BACK, the sticky
+window and rolling flap window keep an oscillating device from
+bouncing the campaign forever. Everything here runs on synthetic
+ladders (a pretend "tpu" tier on the CPU box, ``env_pin=False``) with
+injected probes — no subprocess probe, no engine, except the one
+terminal-tier probe that is defined to pass without spawning.
+"""
+
+import time
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.backend import (PROFILES, TIER_ORDER, TIER_RUNG,
+                                 TIER_RUNG_ALIAS, TierManager,
+                                 available_tiers, default_oom_ladder,
+                                 detect_tiers, parse_tiers, probe_tier,
+                                 profile, terminal_tier, tier_of_platform,
+                                 tiers_below)
+from mythril_tpu.resilience import (BackendManager, DeviceLostError,
+                                    FaultInjector, FaultSpec, parse_ladder)
+
+# --- profile registry -------------------------------------------------
+
+
+def test_profile_registry_shape():
+    assert set(PROFILES) == {"tpu", "gpu", "cpu"}
+    assert [profile(t).rank for t in TIER_ORDER] == [0, 1, 2]
+    assert TIER_ORDER == ("tpu", "gpu", "cpu")
+    assert terminal_tier() == "cpu"
+    assert profile("gpu").jax_platform == "cuda"
+    with pytest.raises(ValueError, match="unknown backend tier"):
+        profile("quantum")
+
+
+def test_oom_ladders_per_tier():
+    # the best tier's ladder ends on the tier rung (step down a tier);
+    # the floor's ladder cannot — there is nothing below the floor
+    assert default_oom_ladder() == ("halve-lanes", "halve-batch", TIER_RUNG)
+    assert TIER_RUNG in profile("tpu").oom_ladder
+    assert TIER_RUNG not in profile("cpu").oom_ladder
+    # the modern alias spelling normalizes to the historical rung name
+    assert parse_ladder(f"halve-lanes,{TIER_RUNG_ALIAS}") == (
+        "halve-lanes", TIER_RUNG)
+
+
+def test_parse_and_detect_tiers(monkeypatch):
+    assert parse_tiers("cpu,tpu") == ("tpu", "cpu")      # ranked
+    assert parse_tiers(("gpu",)) == ("gpu", "cpu")       # floor appended
+    assert parse_tiers("tpu,tpu,cpu") == ("tpu", "cpu")  # deduped
+    with pytest.raises(ValueError):
+        parse_tiers("tpu,quantum")
+    monkeypatch.setenv("MYTHRIL_BACKEND_TIERS", "gpu,cpu")
+    assert detect_tiers() == ("gpu", "cpu")              # env wins
+    monkeypatch.delenv("MYTHRIL_BACKEND_TIERS")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert detect_tiers() == ("cpu",)                    # pinned process
+    assert tiers_below("tpu") == ("gpu", "cpu")
+    assert tiers_below("cpu") == ()
+
+
+def test_tier_of_platform_mapping():
+    assert tier_of_platform("cpu") == "cpu"
+    assert tier_of_platform("cuda") == "gpu"
+    assert tier_of_platform("tpu") == "tpu"
+    assert tier_of_platform("cpu-fallback") == "cpu"
+    assert tier_of_platform("METAL") is None
+    assert tier_of_platform(None) is None
+
+
+def test_terminal_probe_never_spawns():
+    # the floor must stay reachable even when subprocess spawn is
+    # impossible — probing it is defined to pass without a child
+    ok, diag = probe_tier("cpu", timeout_s=0.0)
+    assert ok
+    tiers = available_tiers(
+        tiers=("tpu", "cpu"),
+        probe_fn=lambda t, s: (False, "down"))
+    assert tiers == ("cpu",)                             # floor always in
+
+
+# --- TierManager state machine ----------------------------------------
+
+
+def _tm(probe, **kw):
+    kw.setdefault("sticky_window", 0.0)
+    kw.setdefault("probe_every", 0.0)
+    kw.setdefault("auto_prober", False)
+    return TierManager(tiers=("tpu", "cpu"), probe_fn=probe,
+                       env_pin=False, **kw)
+
+
+def test_demote_floor_and_stale_reports_are_noops():
+    tm = _tm(lambda t, s: (True, "up"))
+    assert not tm.demoted() and tm.current == "tpu"
+    assert tm.demote(reason="crash loop") == "cpu"
+    assert tm.demoted() and tm.demotions == 1 and tm.generation == 1
+    # stale report against the tier we already left: no double-demote
+    assert tm.demote(reason="late report", failed="tpu") == "cpu"
+    # floor: nothing below, no transition, no generation churn
+    assert tm.demote(reason="floor fault") == "cpu"
+    assert tm.demotions == 1 and tm.generation == 1
+    assert [e["kind"] for e in tm.events] == ["tier_demoted"]
+
+
+def test_repromote_lifecycle_with_probe_gate():
+    probes = []
+
+    def probe(tier, timeout):
+        probes.append((tier, timeout))
+        return len(probes) >= 2, "flaky then up"
+
+    tm = _tm(probe)
+    tm.demote(reason="device-lost")
+    assert not tm.tick()                    # probe 1 fails -> stay down
+    assert tm.probe_failures == 1 and tm.demoted()
+    assert tm.tick()                        # probe 2 passes -> climb
+    assert tm.current == tm.preferred == "tpu"
+    assert tm.repromotions == 1 and tm.generation == 2
+    # probes target the BETTER tier with its profile's own deadline
+    assert probes == [("tpu", profile("tpu").probe_timeout)] * 2
+    kinds = [e["kind"] for e in tm.events]
+    assert kinds == ["tier_demoted", "tier_probe_failed",
+                     "tier_repromoted"]
+    assert not tm.tick()                    # at preferred: nothing to do
+
+
+def test_sticky_window_holds_fresh_demotions():
+    tm = _tm(lambda t, s: (True, "up"), sticky_window=60.0)
+    tm.demote(reason="crash")
+    assert not tm.maybe_repromote()         # inside the sticky window
+    assert tm.probe_failures == 0           # never even probed
+    tm._demoted_at -= 61.0                  # age the demotion out
+    assert tm.maybe_repromote()
+
+
+def test_flap_damping_caps_transitions_and_emits_once():
+    tm = _tm(lambda t, s: (True, "up"), flap_window=3600.0, flap_max=4)
+    tm.demote(reason="flap 1")
+    assert tm.maybe_repromote()             # round trip 1 (2 transitions)
+    tm.demote(reason="flap 2")              # 3 transitions in window
+    assert not tm.maybe_repromote()         # 3 + 2 > flap_max: damped
+    assert not tm.maybe_repromote()         # still damped, no event spam
+    kinds = [e["kind"] for e in tm.events]
+    assert kinds.count("tier_flap_damped") == 1
+    assert tm.demoted() and len(tm._transitions) <= tm.flap_max
+    # drain the window -> damping lifts and a NEW episode gets its own
+    # marker
+    tm._transitions.clear()
+    assert tm.maybe_repromote()
+    tm.demote(reason="flap 3")
+    assert tm.maybe_repromote()
+    tm.demote(reason="flap 4")
+    assert not tm.maybe_repromote()
+    assert [e["kind"] for e in tm.events].count("tier_flap_damped") == 2
+
+
+def test_background_prober_climbs_without_operator(tmp_path):
+    wedge = tmp_path / "wedge"
+    wedge.write_text("wedged")
+
+    def probe(tier, timeout):
+        return not wedge.exists(), "wedge file"
+
+    tm = _tm(probe, probe_every=0.02, auto_prober=True,
+             flap_window=60.0, flap_max=6)
+    tm.demote(reason="wedged device")       # starts the prober thread
+    time.sleep(0.15)
+    assert tm.demoted() and tm.probe_failures >= 1
+    wedge.unlink()                          # the tier recovers
+    deadline = time.monotonic() + 10.0
+    while tm.demoted() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not tm.demoted() and tm.repromotions == 1
+    tm.stop_prober()
+
+
+def test_status_and_metrics_names():
+    tm = _tm(lambda t, s: (True, "up"))
+    tm.demote(reason="x")
+    tm.tick()
+    st = tm.status()
+    assert (st["current"], st["preferred"]) == ("tpu", "tpu")
+    assert st["demotions"] == st["repromotions"] == 1
+    assert st["generation"] == 2 and not st["demoted"]
+    from mythril_tpu.obs import metrics as obs_metrics
+
+    snap = obs_metrics.REGISTRY.snapshot()
+    assert "engine_tier_demotions_total" in snap["counters"]
+    assert "engine_tier_repromotions_total" in snap["counters"]
+    assert "engine_backend_tier" in snap["gauges"]
+    assert snap["gauges"]["engine_backend_tier"] == profile("tpu").rank
+
+
+# --- flap fault mode --------------------------------------------------
+
+
+def test_fault_spec_flap_parses_and_alternates():
+    spec = FaultSpec.parse("flap")          # unconditional IS the point
+    assert spec.mode == "flap"
+    inj = FaultInjector([spec])
+    for attempt in range(1, 7):
+        if attempt % 2 == 1:                # odd attempts: down-phase
+            with pytest.raises(DeviceLostError, match="flapping"):
+                inj.fire(batch=0, contracts=("c000",))
+        else:                               # even attempts: clean pass
+            inj.fire(batch=0, contracts=("c000",))
+    assert spec.fired == 3                  # only down-phases count
+    assert all(rec["mode"] == "flap" for rec in inj.log)
+
+
+def test_fault_spec_flap_respects_times():
+    inj = FaultInjector([FaultSpec.parse("flap:times=1")])
+    with pytest.raises(DeviceLostError):
+        inj.fire(batch=0)
+    for _ in range(4):                      # bounded: one down-phase only
+        inj.fire(batch=0)
+
+
+# --- campaign integration (stub runner, no engine) --------------------
+
+
+def _stub_runner(bi, names, codes):
+    return {"issues": [{"contract": n, "batch": bi}
+                       for n in names if not n.startswith("_pad_")],
+            "paths": len(names), "dropped": 0, "iprof": {}}
+
+
+def _stub_campaign(ckpt, fault, tm):
+    from mythril_tpu.mythril.campaign import CorpusCampaign
+
+    camp = CorpusCampaign(
+        [(f"c{i:03d}", b"\x00") for i in range(6)],
+        batch_size=2, checkpoint_dir=ckpt, spec=object(),
+        batch_timeout=5.0, max_batch_retries=1,
+        fault_injector=FaultInjector.from_string(fault),
+        batch_runner=_stub_runner, tier_manager=tm)
+    # keep the device-lost recovery probe in-process (no subprocess)
+    camp.backend = BackendManager(probe_fn=lambda t: (True, "OK"),
+                                  backoff=0.0)
+    return camp
+
+
+def test_campaign_demotes_on_device_lost_and_invalidates_warm(tmp_path):
+    tm = _tm(lambda t, s: (False, "still down"))
+    camp = _stub_campaign(str(tmp_path / "d"),
+                          "device-lost:batch=1:times=1", tm)
+    camp._warm_set().add("warm-marker")     # a cached executable shape
+    res = camp.run()
+    assert res.retries == 1 and not res.quarantined
+    assert len(res.issues) == 6             # parity: nothing lost
+    assert tm.demoted() and tm.current == "cpu" and tm.demotions == 1
+    # the transition was folded at a batch boundary: warm markers gone
+    assert not any(camp._warm_shapes.values())
+    kinds = [e["kind"] for e in res.backend_events]
+    assert "tier_demoted" in kinds and "tier_applied" in kinds
+    st = camp.tier_status()
+    assert st is not None and st["current"] == "cpu"
+
+
+def test_campaign_repromotes_mid_run(tmp_path):
+    tm = _tm(lambda t, s: (True, "recovered"))
+    camp = _stub_campaign(str(tmp_path / "r"),
+                          "device-lost:batch=0:times=1", tm)
+    res = camp.run()
+    assert res.retries == 1 and not res.quarantined
+    assert len(res.issues) == 6
+    # demoted on the loss, climbed back at a later batch boundary
+    assert not tm.demoted() and tm.current == "tpu"
+    assert tm.demotions == 1 and tm.repromotions == 1
+    kinds = [e["kind"] for e in res.backend_events]
+    assert kinds.count("tier_demoted") == 1
+    assert kinds.count("tier_repromoted") == 1
+
+
+def test_campaign_flap_is_damped_not_endless(tmp_path):
+    tm = _tm(lambda t, s: (True, "up"), flap_window=3600.0, flap_max=4)
+    camp = _stub_campaign(str(tmp_path / "f"), "flap", tm)
+    res = camp.run()
+    assert not res.quarantined and len(res.issues) == 6
+    assert res.batch_status == ["ok-retry"] * 3
+    # one full round trip, then the window holds the floor
+    assert tm.demotions == 2 and tm.repromotions == 1
+    assert len(tm._transitions) <= tm.flap_max
+    assert tm.demoted() and tm.current == "cpu"
+    kinds = [e["kind"] for e in res.backend_events]
+    assert kinds.count("tier_flap_damped") == 1
+
+
+# --- BackendManager tier walk -----------------------------------------
+
+
+def test_ensure_or_fallback_walks_tiers(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("MYTHRIL_BACKEND_TIERS", raising=False)
+    bm = BackendManager(init_timeout=0.1, max_attempts=1, backoff=0.0,
+                        probe_fn=lambda t: (False, "wedged"))
+    ok, diag = bm.ensure_or_fallback(tiers=("tpu", "cpu"))
+    assert not ok
+    import os
+
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    # landing on the terminal tier keeps the historical event name
+    assert bm.events[-1]["kind"] == "cpu_fallback"
+
+
+def test_config_carries_tier_knobs():
+    from mythril_tpu.config import DEFAULT_RESILIENCE
+
+    assert DEFAULT_RESILIENCE.backend_tiers is None
+    assert DEFAULT_RESILIENCE.tier_flap_max >= 2
+    assert DEFAULT_RESILIENCE.oom_ladder == default_oom_ladder()

@@ -94,6 +94,8 @@ MATRIX: Dict[str, Tuple[str, ...]] = {
     "fleet": tuple(_WORKER_POINTS) + ("torn-ledger", "frozen-heartbeat"),
     "serve": tuple(_WORKER_POINTS),
     "replica": ("kill-replica-mid-batch", "torn-store-verdict"),
+    "tier": ("demote-mid-campaign", "repromote-mid-campaign",
+             "tier-flap"),
 }
 
 N = 6  # distinct bytecodes (serve dedupe would collapse clones)
@@ -165,6 +167,109 @@ def _cell_batch(mode: str, point: str, d: str, contracts,
                   and not res.quarantined
                   and kinds.count("worker_death") >= 1
                   and kinds.count("worker_restart") >= 1
+                  and final.get("next_batch") == (N + 1) // 2)
+    return cell
+
+
+def _tier_kinds(events) -> List[str]:
+    return [e.get("kind") for e in events
+            if str(e.get("kind", "")).startswith("tier")]
+
+
+#: three stacked nth= specs = the worker dies on its first three
+#: dispatches, which trips the supervisor's crash-loop breaker
+_CRASH_LOOP = "worker-kill:nth=1;worker-kill:nth=2;worker-kill:nth=3"
+
+
+def _tier_tm(probe_ok: bool, **kw):
+    """Synthetic two-tier ladder for a CPU-only box: "tpu" is an
+    accounting tier (``env_pin=False`` keeps execution on the host),
+    so demote/re-promote mechanics run for real while every batch
+    executes on the same backend as the uninjected baseline."""
+    from mythril_tpu.backend import TierManager
+
+    def probe(tier, timeout):
+        return probe_ok, f"chaos probe ({'up' if probe_ok else 'down'})"
+
+    kw.setdefault("sticky_window", 0.0)
+    kw.setdefault("probe_every", 0.0)
+    return TierManager(tiers=("tpu", "cpu"), probe_fn=probe,
+                       env_pin=False, auto_prober=False, **kw)
+
+
+def _cell_tier_crash(point: str, d: str, contracts,
+                     baseline: List[str]) -> Dict:
+    """demote-mid-campaign / repromote-mid-campaign: a worker crash
+    loop opens the breaker mid-campaign; instead of a permanent CPU
+    pin the campaign demotes one tier and keeps going. With a healthy
+    probe the next batch boundary climbs back to the preferred tier."""
+    from mythril_tpu.resilience import FaultInjector
+    from mythril_tpu.utils.checkpoint import load_json_checkpoint
+
+    repromote = (point == "repromote-mid-campaign")
+    tm = _tier_tm(probe_ok=repromote)
+    ckpt = os.path.join(d, "ck")
+    res = _campaign(contracts, ckpt, worker_isolation="on",
+                    fault_injector=FaultInjector.from_string(_CRASH_LOOP),
+                    tier_manager=tm).run()
+    wk = _worker_kinds(res.backend_events)
+    tk = _tier_kinds(res.backend_events)
+    final = load_json_checkpoint(os.path.join(ckpt, "campaign.json"))
+    st = tm.status()
+    cell = {"issues": _issues(res), "retries": res.retries,
+            "quarantined": [q["name"] for q in res.quarantined],
+            "worker_events": wk, "tier_events": tk, "tier": st,
+            "next_batch": final.get("next_batch")}
+    ok = (cell["issues"] == baseline
+          and len(res.issues) == len(baseline)
+          and not res.quarantined
+          and wk.count("worker_death") >= 3
+          and st["demotions"] == 1
+          and tk.count("tier_demoted") == 1
+          and final.get("next_batch") == (N + 1) // 2)
+    if repromote:
+        ok = (ok and st["current"] == st["preferred"]
+              and st["repromotions"] == 1
+              and tk.count("tier_repromoted") == 1)
+    else:
+        ok = (ok and st["current"] == "cpu" and st["demoted"]
+              and st["repromotions"] == 0
+              and st["probe_failures"] >= 1)
+    cell["ok"] = ok
+    return cell
+
+
+def _cell_tier_flap(d: str, contracts, baseline: List[str]) -> Dict:
+    """tier-flap: a flapping device (down on odd attempts, up on even)
+    would bounce the campaign between tiers forever; the rolling flap
+    window must cap transitions, hold the lower tier, and emit the
+    damped marker exactly once — with issue parity and exactly-once
+    batch accounting intact throughout."""
+    from mythril_tpu.resilience import FaultInjector
+    from mythril_tpu.utils.checkpoint import load_json_checkpoint
+
+    tm = _tier_tm(probe_ok=True, flap_window=3600.0, flap_max=4)
+    ckpt = os.path.join(d, "ck")
+    res = _campaign(contracts, ckpt, worker_isolation="off",
+                    fault_injector=FaultInjector.from_string("flap"),
+                    tier_manager=tm).run()
+    tk = _tier_kinds(res.backend_events)
+    final = load_json_checkpoint(os.path.join(ckpt, "campaign.json"))
+    st = tm.status()
+    cell = {"issues": _issues(res), "retries": res.retries,
+            "quarantined": [q["name"] for q in res.quarantined],
+            "tier_events": tk, "tier": st,
+            "next_batch": final.get("next_batch")}
+    cell["ok"] = (cell["issues"] == baseline
+                  and len(res.issues) == len(baseline)
+                  and not res.quarantined
+                  and res.retries == (N + 1) // 2
+                  # one full round trip, then damping holds the floor
+                  and st["demotions"] == 2
+                  and st["repromotions"] == 1
+                  and st["transitions_in_window"] <= tm.flap_max
+                  and st["current"] == "cpu" and st["demoted"]
+                  and tk.count("tier_flap_damped") == 1
                   and final.get("next_batch") == (N + 1) // 2)
     return cell
 
@@ -486,6 +591,11 @@ def run_cell(mode: str, point: str, contracts,
             return _cell_torn_ledger(d, contracts, baseline)
         if mode == "fleet" and point == "frozen-heartbeat":
             return _cell_frozen_heartbeat(d, contracts, baseline)
+        if mode == "tier" and point in ("demote-mid-campaign",
+                                        "repromote-mid-campaign"):
+            return _cell_tier_crash(point, d, contracts, baseline)
+        if mode == "tier" and point == "tier-flap":
+            return _cell_tier_flap(d, contracts, baseline)
         if mode == "replica" and point == "kill-replica-mid-batch":
             return _cell_replica_kill(d, contracts, baseline)
         if mode == "replica" and point == "torn-store-verdict":

@@ -134,7 +134,7 @@ N = 6  # even indices killable -> expected issues c000/c002/c004
 
 LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry",
         "pipeline", "fleet", "serve", "solver_store", "chaos",
-        "replicas")
+        "replicas", "tiers")
 
 
 def write_corpus(d: str) -> str:
@@ -682,6 +682,66 @@ def main() -> int:
                    and issues == ["c000", "c002", "c004"]
                    and again["state"] == "done"
                    and legs["replicas"]["resubmit_all_dedupe"])
+
+        if "tiers" in want:
+            # leg 13: wedge the preferred tier mid-campaign — the
+            # campaign finishes on the demoted tier exactly-once; un-
+            # wedging lets the BACKGROUND prober re-promote with no
+            # operator intervention, and the next campaign runs on the
+            # recovered tier
+            import time as _time
+
+            from mythril_tpu.backend import TierManager
+            from mythril_tpu.utils.checkpoint import load_json_checkpoint
+
+            wedge = os.path.join(d, "tier_wedge")
+            with open(wedge, "w") as fh:
+                fh.write("wedged")
+
+            def tier_probe(tier, timeout):
+                up = not os.path.exists(wedge)
+                return up, "clear" if up else "wedged"
+
+            tm = TierManager(tiers=("tpu", "cpu"), probe_fn=tier_probe,
+                             sticky_window=0.0, flap_window=60.0,
+                             flap_max=6, probe_every=0.05,
+                             env_pin=False)
+            r1 = campaign(corpus, os.path.join(d, "ck13"),
+                          "device-lost:batch=1:times=1",
+                          tier_manager=tm).run()
+            st1 = tm.status()
+            fin1 = load_json_checkpoint(
+                os.path.join(d, "ck13", "campaign.json"))
+            os.unlink(wedge)  # the "tpu" tier recovers
+            deadline = _time.monotonic() + 30
+            while tm.demoted() and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            st_up = tm.status()
+            r2 = campaign(corpus, os.path.join(d, "ck13b"), None,
+                          tier_manager=tm).run()
+            st2 = tm.status()
+            tm.stop_prober()
+            legs["tiers"] = {
+                "after_wedged_campaign": st1,
+                "checkpoint": fin1.get("next_batch"),
+                "after_unwedge": st_up,
+                "after_recovered_campaign": st2,
+                "issues1": sorted(i["contract"] for i in r1.issues),
+                "issues2": sorted(i["contract"] for i in r2.issues),
+                "retries": r1.retries}
+            ok &= (r1.retries == 1 and not r1.quarantined
+                   and legs["tiers"]["issues1"] == ["c000", "c002",
+                                                    "c004"]
+                   and st1["demoted"] and st1["current"] == "cpu"
+                   and st1["demotions"] == 1
+                   and fin1.get("next_batch") == 2  # exactly-once
+                   and not st_up["demoted"]  # prober climbed back
+                   and st_up["repromotions"] == 1
+                   and st2["current"] == st2["preferred"]
+                   and st2["demotions"] == 1  # campaign 2 clean
+                   and not r2.quarantined
+                   and legs["tiers"]["issues2"] == ["c000", "c002",
+                                                    "c004"])
 
         if "chaos" in want:
             # leg 11: the reduced chaos matrix (one engine-worker
