@@ -64,6 +64,9 @@ import sys
 import time
 from typing import BinaryIO, Dict, Optional
 
+from .obs import metrics as obs_metrics
+from .obs import trace as obs_trace
+
 #: frame header: one 8-byte big-endian payload length
 FRAME_HEADER = struct.Struct(">Q")
 
@@ -213,28 +216,57 @@ def _run_batch(camp, stub: bool, msg: Dict,
     codes = list(msg["codes"])
     lanes = msg.get("lanes")
     width = msg.get("width")
-    if fault is not None:
-        fault.fire("mid-compile", nth)
-    if stub:
-        if "__hang__" in names:
-            time.sleep(3600)
+    # re-enter the parent's request trace scope: every span/event this
+    # batch emits (device_phase, superstep, solver stages) carries the
+    # same trace_id the HTTP submit minted, two processes away
+    with obs_trace.apply_context(msg.get("trace")):
         if fault is not None:
-            fault.fire("mid-superstep", nth)
-        return {"issues": [], "paths": len(names), "dropped": 0,
-                "iprof": {}}
-    # tier pin: honor the explicit tier label when present (a demoted
-    # parent pins degraded batches to its tier), else the historical
-    # on_cpu bool from older supervisors
-    tier = msg.get("on_tier") or ("cpu" if msg.get("on_cpu") else None)
-    cm = camp._tier_device(tier) if tier else None
-    with (cm if cm is not None else contextlib.nullcontext()):
-        sym = camp._explore_batch(bi, names, codes, lanes, width)
-        if fault is not None:
-            # after the device work ran, before the host harvest: the
-            # closest honest stand-in for "mid-superstep" a process
-            # boundary allows
-            fault.fire("mid-superstep", nth)
-        return camp._harvest_batch(bi, sym)
+            fault.fire("mid-compile", nth)
+        if stub:
+            if "__hang__" in names:
+                time.sleep(3600)
+            with obs_trace.timer("device_phase", bi=bi,
+                                 n=len(names)) as dv:
+                if fault is not None:
+                    fault.fire("mid-superstep", nth)
+            return {"issues": [], "paths": len(names), "dropped": 0,
+                    "iprof": {},
+                    "phases": {"device": dv.dur or 0.0, "host": 0.0}}
+        # tier pin: honor the explicit tier label when present (a
+        # demoted parent pins degraded batches to its tier), else the
+        # historical on_cpu bool from older supervisors
+        tier = (msg.get("on_tier")
+                or ("cpu" if msg.get("on_cpu") else None))
+        cm = camp._tier_device(tier) if tier else None
+        with (cm if cm is not None else contextlib.nullcontext()):
+            with obs_trace.timer("device_phase", bi=bi,
+                                 n=len(names)) as dv:
+                sym = camp._explore_batch(bi, names, codes, lanes,
+                                          width)
+                if fault is not None:
+                    # after the device work ran, before the host
+                    # harvest: the closest honest stand-in for
+                    # "mid-superstep" a process boundary allows
+                    fault.fire("mid-superstep", nth)
+            with obs_trace.timer("host_phase", bi=bi) as hp:
+                out = camp._harvest_batch(bi, sym)
+        out["phases"] = {"device": dv.dur or 0.0, "host": hp.dur or 0.0}
+        return out
+
+
+def _drain_telemetry(msnap: Optional[Dict]) -> Optional[Dict]:
+    """The per-reply telemetry payload: buffered spans/events, a fresh
+    child ``monotonic()`` reading (the parent refreshes its clock
+    offset against it), and the metric delta since the last reply.
+    ``None`` when the parent didn't ask for tracing at init."""
+    tracer = obs_trace.get_tracer()
+    if tracer is None or tracer.buffer_records is None:
+        return None
+    after = obs_metrics.REGISTRY.snapshot()
+    return {"records": tracer.drain_buffer(),
+            "mono": time.monotonic(),
+            "metrics": obs_metrics.snapshot_delta(after, msnap or {}),
+            "_after": after}
 
 
 def worker_main() -> int:
@@ -248,6 +280,7 @@ def worker_main() -> int:
     camp = None
     stub = False
     nbatch = 0
+    msnap: Optional[Dict] = None
     while True:
         msg = read_frame(inp)
         if msg is None:
@@ -257,19 +290,31 @@ def worker_main() -> int:
         try:
             if op == "init":
                 stub = bool(msg.get("stub"))
+                if msg.get("trace"):
+                    # parent is tracing: buffer spans/events locally
+                    # and ship them back with each batch reply
+                    obs_trace.configure(buffer=True)
+                    msnap = obs_metrics.REGISTRY.snapshot()
                 if not stub:
                     camp = _build_campaign(msg.get("config") or {})
+                # the child monotonic reading is half of the clock
+                # handshake: the parent computes
+                # offset = parent_mono - child_mono for span stitching
                 reply = {"ok": True,
                          "value": {"pid": os.getpid(), "stub": stub,
-                                   "protocol": PROTOCOL_VERSION}}
+                                   "protocol": PROTOCOL_VERSION,
+                                   "mono": time.monotonic()}}
             elif op == "ping":
                 reply = {"ok": True, "value": {"pid": os.getpid(),
                                                "rss": _rss_bytes()}}
             elif op == "batch":
                 nbatch += 1
-                reply = {"ok": True,
-                         "value": _run_batch(camp, stub, msg, fault,
-                                             nbatch)}
+                value = _run_batch(camp, stub, msg, fault, nbatch)
+                tel = _drain_telemetry(msnap)
+                if tel is not None:
+                    msnap = tel.pop("_after")
+                    value["telemetry"] = tel
+                reply = {"ok": True, "value": value}
                 tear = (fault is not None
                         and fault.should("mid-reply", nbatch))
             elif op == "exit":

@@ -354,7 +354,9 @@ class WorkLedger:
         obs_metrics.REGISTRY.counter(
             "fleet_units_fed_total",
             help="work units appended to feed ledgers").inc()
-        self._event("unit_fed", unit=uid, contracts=len(names))
+        tids = ((config or {}).get("trace") or {}).get("ids") or []
+        self._event("unit_fed", unit=uid, contracts=len(names),
+                    trace_id=(tids[0] if tids else None))
         return uid
 
     def feed_close(self) -> None:

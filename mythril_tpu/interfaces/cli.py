@@ -489,6 +489,12 @@ def create_parser() -> argparse.ArgumentParser:
     sv.add_argument("--metrics", metavar="FILE",
                     help="metrics snapshot at exit (the live registry "
                          "is always scrapeable at /metrics)")
+    sv.add_argument("--heartbeat", type=float, default=None,
+                    metavar="SEC",
+                    help="print a one-line serving heartbeat to stderr "
+                         "every SEC seconds: queue depth, inflight, "
+                         "store size, and end-to-end request latency "
+                         "p50/p95 (serve_request_seconds)")
 
     ld = sub.add_parser("list-detectors",
                         help="list registered detection modules")
@@ -623,7 +629,11 @@ def exec_analyze(args) -> int:
     if getattr(args, "metrics", None):
         obs_metrics.REGISTRY.enabled = True
     try:
-        return _exec_analyze_inner(args)
+        # CLI ingestion point: the whole analyze invocation is one
+        # request trace — every span/event it emits (including fleet
+        # units fed to other hosts) carries this id
+        with obs_trace.trace_context():
+            return _exec_analyze_inner(args)
     finally:
         # best-effort: a failed telemetry flush (unwritable dir, full
         # disk) must not mask the analysis result or its exception
@@ -1012,6 +1022,8 @@ def exec_serve(args) -> int:
         if args.port_file:
             with open(args.port_file, "w") as fh:
                 fh.write(str(daemon.port))
+        if args.heartbeat:
+            _serve_heartbeat(daemon, args.heartbeat)
         daemon.wait_stopped()
     finally:
         daemon.shutdown("exit")
@@ -1028,6 +1040,33 @@ def exec_serve(args) -> int:
                 print(f"warning: metrics write failed: {exc}",
                       file=sys.stderr)
     return 0
+
+
+def _serve_heartbeat(daemon, period: float) -> None:
+    """Start the serving heartbeat: one stderr line every ``period``
+    seconds with queue depth, store size, and end-to-end request
+    latency percentiles from the live ``serve_request_seconds``
+    histogram (docs/observability.md "Heartbeat"). Daemon thread —
+    dies with the process, never blocks drain."""
+    import threading
+
+    from ..obs import metrics as obs_metrics
+
+    def _loop() -> None:
+        while not daemon.wait_stopped(timeout=max(0.2, period)):
+            rh = obs_metrics.REGISTRY.histogram(
+                "serve_request_seconds",
+                help="end-to-end request latency (submit to resolve)")
+            rq = ""
+            if rh.count:
+                p50, p95 = rh.quantile(0.5), rh.quantile(0.95)
+                rq = f" | req p50 {p50:.2f}s/p95 {p95:.2f}s"
+            print(f"[serve] depth {daemon.queue.depth()} "
+                  f"store {daemon.store.count()}{rq}",
+                  file=sys.stderr, flush=True)
+
+    threading.Thread(target=_loop, daemon=True,
+                     name="serve-heartbeat").start()
 
 
 def _write_statespace(path: str, analyzer) -> None:

@@ -655,9 +655,18 @@ class CorpusCampaign:
         """Analyze one (padded) batch; returns the batch's partial
         results. Serial composition of the device + host phases — the
         unit of work the watchdog guards and the bisection replays on
-        sub-batches."""
-        return self._harvest_batch(
-            bi, self._explore_batch(bi, names, codes, lanes, width))
+        sub-batches. Each phase runs inside its own span, and the
+        durations feed the per-request stage attribution
+        (docs/observability.md "Per-stage latency")."""
+        with obs_trace.timer("device_phase", bi=bi, n=len(names)) as dv:
+            sym = self._explore_batch(bi, names, codes, lanes, width)
+        with obs_trace.timer("host_phase", bi=bi) as hp:
+            out = self._harvest_batch(bi, sym)
+        acc = getattr(self, "_phase_acc", None)
+        if acc is not None:
+            acc["device"] += dv.dur or 0.0
+            acc["host"] += hp.dur or 0.0
+        return out
 
     # --- supervised engine worker (docs/resilience.md) ------------------
     def _worker_enabled(self) -> bool:
@@ -721,10 +730,32 @@ class CorpusCampaign:
                     on_tier: Optional[str]) -> Dict:
         """One batch through the supervisor (which enforces the
         per-batch deadline parent-side — no extra watchdog thread).
-        Success marks the shape class worker-warm."""
+        Success marks the shape class worker-warm. The reply's
+        child-measured ``phases`` feed the stage attribution: host time
+        is the child's own reading; device time is parent wall minus
+        it, so spawn + IPC cost lands on the device side (it stalls the
+        same pipeline slot device work does)."""
         sup = self._ensure_supervisor()
-        out = sup.run_batch(bi, names, codes, lanes=lanes, width=width,
-                            on_cpu=(on_tier == "cpu"), on_tier=on_tier)
+        t0 = time.monotonic()
+        try:
+            out = sup.run_batch(bi, names, codes, lanes=lanes,
+                                width=width, on_cpu=(on_tier == "cpu"),
+                                on_tier=on_tier)
+        except BaseException:
+            # a failed attempt (worker death, deadline) stalled the
+            # pipeline slot too: charge its wall to the device stage so
+            # per-request timings still sum to the request wall
+            acc = getattr(self, "_phase_acc", None)
+            if acc is not None:
+                acc["device"] += max(0.0, time.monotonic() - t0)
+            raise
+        wall = time.monotonic() - t0
+        ph = out.pop("phases", None) if isinstance(out, dict) else None
+        acc = getattr(self, "_phase_acc", None)
+        if acc is not None:
+            h = float((ph or {}).get("host") or 0.0)
+            acc["host"] += h
+            acc["device"] += max(0.0, wall - h)
         self._warm_set(lanes, width).add(_WORKER_WARM)
         return out
 
@@ -777,6 +808,10 @@ class CorpusCampaign:
             bi = self._extern_batches
         self._extern_batches = max(self._extern_batches, bi) + 1
         items = list(items)
+        # per-batch device/host attribution accumulator: filled by
+        # _exec_batch (in-process) or _worker_run (isolation on) across
+        # every retry/degrade/bisect attempt this batch takes
+        self._phase_acc = {"device": 0.0, "host": 0.0}
         with obs_trace.timer("batch", bi=bi, n=len(items),
                              resident=True) as sp:
             out = self._run_batch_resilient(bi, items)
@@ -795,6 +830,7 @@ class CorpusCampaign:
         self._portfolio_event(SOLVER_STATS.as_dict())
         out["wall_sec"] = sp.elapsed
         out["batch"] = bi
+        out["phases"] = dict(self._phase_acc)
         return out
 
     # --- fault isolation ----------------------------------------------
@@ -1002,15 +1038,18 @@ class CorpusCampaign:
                                  self.batch_timeout,
                                  label=f"batch {bi} host")
 
-    def _host_phase_job(self, bi: int, handle):
+    def _host_phase_job(self, bi: int, handle, tctx=None):
         """Worker-thread entry: run the host phase inside a span and
         return ``(out, host_dur, done_mono)`` so the commit side can
-        account overlap (hidden host seconds) and worker idle."""
-        sp = obs_trace.timer("host_phase", bi=bi).start()
-        try:
-            out = self._host_phase_work(bi, handle)
-        finally:
-            sp.stop()
+        account overlap (hidden host seconds) and worker idle. ``tctx``
+        re-enters the submitting thread's trace scope (contextvars
+        don't cross the pool boundary on their own)."""
+        with obs_trace.apply_context(tctx):
+            sp = obs_trace.timer("host_phase", bi=bi).start()
+            try:
+                out = self._host_phase_work(bi, handle)
+            finally:
+                sp.stop()
         return out, sp.dur or 0.0, time.monotonic()
 
     @staticmethod
@@ -1246,10 +1285,21 @@ class CorpusCampaign:
         tk = ""
         if tier is not None:
             tk = f" tier={tier}" + ("!" if self._tm.demoted() else "")
+        # serving token: end-to-end request latency percentiles from
+        # the serve_request_seconds histogram — SLO drift on the same
+        # line the operator already watches, no /metrics scrape needed
+        rq = ""
+        req_p50 = req_p95 = None
+        rh = obs_metrics.REGISTRY.histogram(
+            "serve_request_seconds",
+            help="end-to-end request latency (submit to resolve)")
+        if rh.count:
+            req_p50, req_p95 = rh.quantile(0.5), rh.quantile(0.95)
+            rq = f" req p50 {req_p50:.2f}s/p95 {req_p95:.2f}s"
         print(f"heartbeat: batch {done}/{total} contracts {contracts}/"
               f"{len(self.contracts)} paths/s {pps:.1f} frontier "
               f"{100.0 * occ:.0f}% rung {rung} z3-avoid {z3av:.0f}% "
-              f"ckpt-age {age_s}{wk}{tk}",
+              f"ckpt-age {age_s}{wk}{tk}{rq}",
               file=sys.stderr, flush=True)
         obs_trace.event("heartbeat", batch=done, batches_total=total,
                         contracts=contracts,
@@ -1262,7 +1312,11 @@ class CorpusCampaign:
                                          if wst is not None else None),
                         worker_breaker=(wst["breaker"]
                                         if wst is not None else None),
-                        tier=tier)
+                        tier=tier,
+                        req_p50=(round(req_p50, 4)
+                                 if req_p50 is not None else None),
+                        req_p95=(round(req_p95, 4)
+                                 if req_p95 is not None else None))
 
     # --- the pipelined loop --------------------------------------------
     def _run_pipelined(self, start_batch: int, n_batches: int,
@@ -1409,8 +1463,9 @@ class CorpusCampaign:
                 inflight = {"bi": bi, "items": items, "n": len(items),
                             "dev_dur": dev_dur, "t_wall": t_wall,
                             "mono": t_mono,
-                            "future": pool.submit(self._host_phase_job,
-                                                  bi, handle)}
+                            "future": pool.submit(
+                                self._host_phase_job, bi, handle,
+                                obs_trace.context_snapshot())}
             if inflight is not None:
                 commit_inflight(inflight)
                 inflight = None
@@ -1423,8 +1478,8 @@ class CorpusCampaign:
     # --- elastic fleet mode (docs/fleet.md) -----------------------------
     def _run_unit(self, ledger, unit,
                   deadline: Optional[float] = None,
-                  items: Optional[Sequence[tuple]] = None
-                  ) -> Optional[Dict]:
+                  items: Optional[Sequence[tuple]] = None,
+                  trace: Optional[Dict] = None) -> Optional[Dict]:
         """Analyze one claimed work unit: its contracts stream through
         the same resilient batch machinery as a static run (retry /
         degrade / bisect / quarantine all apply within the unit), under
@@ -1454,7 +1509,13 @@ class CorpusCampaign:
                                      + len(unit.names)])
         base_bi = unit.start // self.batch_size
         reg = obs_metrics.REGISTRY
-        with ledger.renewer(unit):
+        # trace ingestion point (fleet claim): continue the trace the
+        # feeder stamped into the unit config, or mint one here — every
+        # span/event this unit emits carries it either way
+        ids = (list((trace or {}).get("ids") or ())
+               or [obs_trace.new_trace_id()])
+        with obs_trace.trace_context(ids[0], link_ids=ids[1:]), \
+                ledger.renewer(unit):
             for j in range(0, len(items), self.batch_size):
                 if deadline is not None and time.monotonic() >= deadline:
                     ledger.release(unit)
@@ -1589,10 +1650,13 @@ class CorpusCampaign:
                 time.sleep(poll)
                 continue
             items = None
+            ucfg: Dict = {}
             if self.fleet_follow:
-                unames, codes, _cfg = ledger.read_unit(unit.uid)
+                unames, codes, ucfg = ledger.read_unit(unit.uid)
                 items = list(zip(unames, codes))
-            rec = self._run_unit(ledger, unit, deadline, items=items)
+                ucfg = ucfg if isinstance(ucfg, dict) else {}
+            rec = self._run_unit(ledger, unit, deadline, items=items,
+                                 trace=ucfg.get("trace"))
             if rec is None:
                 break  # deadline mid-unit; lease already released
             if ledger.commit(unit, rec):

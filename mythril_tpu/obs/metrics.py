@@ -144,6 +144,23 @@ class Histogram:
                     return
             self.bucket_counts[-1] += 1
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-resolution quantile estimate (``q`` in [0, 1]): the
+        upper bound of the first bucket whose cumulative count reaches
+        ``q * count``, clamped to the observed ``max`` (the heartbeat's
+        ``req p50/p95`` token — coarse by design, no sample storage).
+        ``None`` when empty."""
+        with self._lock:
+            if not self.count:
+                return None
+            target = q * self.count
+            running = 0
+            for le, n in zip(self.buckets, self.bucket_counts):
+                running += n
+                if running >= target:
+                    return min(le, self.max)
+            return self.max
+
     def as_dict(self) -> Dict:
         with self._lock:
             cumulative: Dict[str, int] = {}
@@ -198,8 +215,10 @@ class MetricsRegistry:
         return self._get(label_key(name, labels), Gauge, help=help)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Optional[Sequence[float]] = None) -> Histogram:
-        return self._get(name, Histogram, help=help, buckets=buckets)
+                  buckets: Optional[Sequence[float]] = None,
+                  labels: Optional[Dict] = None) -> Histogram:
+        return self._get(label_key(name, labels), Histogram, help=help,
+                         buckets=buckets)
 
     def reset(self) -> None:
         with self._lock:
@@ -252,10 +271,14 @@ class MetricsRegistry:
             elif isinstance(m, Histogram):
                 head(pn, "histogram", m.help)
                 d = m.as_dict()
+                # merge the series' label block with the ``le`` label
+                # (``x_bucket{stage="device",le="0.5"}``)
+                inner = labels[1:-1] if labels else ""
                 for le, n in d["buckets"].items():
-                    lines.append(f'{pn}_bucket{{le="{le}"}} {n}')
-                lines.append(f"{pn}_sum {d['sum']:g}")
-                lines.append(f"{pn}_count {d['count']}")
+                    lb = f'{inner},le="{le}"' if inner else f'le="{le}"'
+                    lines.append(f"{pn}_bucket{{{lb}}} {n}")
+                lines.append(f"{pn}_sum{labels} {d['sum']:g}")
+                lines.append(f"{pn}_count{labels} {d['count']}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write(self, path: str) -> None:
@@ -282,5 +305,75 @@ def get_registry() -> MetricsRegistry:
     return REGISTRY
 
 
+# --- cross-process metric backhaul (engine worker -> supervisor) --------
+
+def snapshot_delta(after: Dict, before: Dict) -> Dict:
+    """What changed between two :meth:`MetricsRegistry.snapshot` dicts —
+    the engine worker ships this per batch reply so its counter ticks
+    and histogram observations land in the parent registry instead of
+    dying with the subprocess. Counters and histogram counts/buckets are
+    differenced; gauges carry their last value."""
+    out: Dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    b_ctr = before.get("counters", {})
+    for k, v in after.get("counters", {}).items():
+        d = v - b_ctr.get(k, 0.0)
+        if d:
+            out["counters"][k] = round(d, 6)
+    for k, v in after.get("gauges", {}).items():
+        if v != before.get("gauges", {}).get(k):
+            out["gauges"][k] = v
+    b_h = before.get("histograms", {})
+    for k, h in after.get("histograms", {}).items():
+        hb = b_h.get(k, {})
+        dc = h["count"] - hb.get("count", 0)
+        if not dc:
+            continue
+        bb = hb.get("buckets", {})
+        out["histograms"][k] = {
+            "count": dc,
+            "sum": round(h["sum"] - hb.get("sum", 0.0), 6),
+            "min": h.get("min"), "max": h.get("max"),
+            "buckets": {le: n - bb.get(le, 0)
+                        for le, n in h["buckets"].items()},
+        }
+    return out
+
+
+def apply_delta(delta: Optional[Dict],
+                registry: Optional[MetricsRegistry] = None) -> None:
+    """Fold a :func:`snapshot_delta` payload into a registry (the
+    supervisor side of the backhaul). Histogram bucket deltas are
+    de-cumulated back into per-bucket increments; min/max fold through
+    direct comparison."""
+    if not delta:
+        return
+    reg = registry if registry is not None else REGISTRY
+    for k, v in delta.get("counters", {}).items():
+        reg._get(k, Counter).inc(v)
+    for k, v in delta.get("gauges", {}).items():
+        reg._get(k, Gauge).set(v)
+    for k, h in delta.get("histograms", {}).items():
+        m = reg._get(k, Histogram)
+        cum = h.get("buckets", {})
+        with m._lock:
+            m.count += int(h.get("count", 0))
+            m.sum += float(h.get("sum", 0.0))
+            for bound in ("min", "max"):
+                v = h.get(bound)
+                if isinstance(v, (int, float)):
+                    if bound == "min" and v < m.min:
+                        m.min = v
+                    elif bound == "max" and v > m.max:
+                        m.max = v
+            prev = 0
+            for i, le in enumerate(m.buckets):
+                c = cum.get(repr(le), prev)
+                m.bucket_counts[i] += max(0, c - prev)
+                prev = max(prev, c)
+            inf = cum.get("+Inf", prev)
+            m.bucket_counts[-1] += max(0, inf - prev)
+
+
 __all__ = ["SCHEMA", "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry", "REGISTRY", "get_registry", "label_key"]
+           "MetricsRegistry", "REGISTRY", "apply_delta", "get_registry",
+           "label_key", "snapshot_delta"]

@@ -614,6 +614,10 @@ class WorkerSupervisor:
         self.restarts = 0
         self.spawns = 0
         self.rss_bytes = 0
+        #: clock handshake result: ``parent_mono - child_mono``, set at
+        #: init and refreshed per batch reply — added to worker-side
+        #: ``mono`` readings so both processes share one timeline
+        self.mono_offset: Optional[float] = None
         self._deaths: "collections.deque[float]" = collections.deque()
         self._consecutive = 0
         self._breaker_opened: Optional[float] = None
@@ -784,8 +788,11 @@ class WorkerSupervisor:
                         detail=f"respawn #{self.restarts}")
         self._event("worker_spawn", pid=self.proc.pid,
                     detail="stub" if self.stub else "engine")
+        from .obs import trace as obs_trace
+
         try:
             self._send({"op": "init", "stub": self.stub,
+                        "trace": obs_trace.active(),
                         "config": self.config})
             rep = self._read_frame(time.monotonic() + self.spawn_timeout)
         except TimeoutError:
@@ -805,6 +812,9 @@ class WorkerSupervisor:
             # config, missing dep): not a crash, but not usable either
             self._reap()
             raise self._rehydrate(rep)
+        child_mono = (rep.get("value") or {}).get("mono")
+        if isinstance(child_mono, (int, float)):
+            self.mono_offset = time.monotonic() - float(child_mono)
 
     def close(self) -> None:
         """Orderly shutdown: ask the worker to exit, then reap."""
@@ -869,6 +879,45 @@ class WorkerSupervisor:
             return DeviceLostError(msg)
         return WorkerError(msg)
 
+    # --- telemetry backhaul (docs/observability.md "Distributed
+    # --- tracing") ------------------------------------------------------
+    def _absorb_telemetry(self, tel, bi: int) -> None:
+        """Land one batch reply's worker-side telemetry in this
+        process: refresh the clock offset from the reply's fresh child
+        ``mono`` reading, re-emit the drained spans/events offset-
+        corrected (tagged ``proc="worker"``), and fold the metric
+        delta into the parent registry."""
+        if not isinstance(tel, dict):
+            return
+        from .obs import metrics as obs_metrics
+        from .obs import trace as obs_trace
+
+        child_mono = tel.get("mono")
+        if isinstance(child_mono, (int, float)):
+            self.mono_offset = time.monotonic() - float(child_mono)
+        off = self.mono_offset or 0.0
+        recs = tel.get("records") or ()
+        if recs:
+            obs_trace.reemit_records(
+                recs, mono_offset=off, proc="worker",
+                wpid=self.proc.pid if self.proc else None)
+        obs_metrics.apply_delta(tel.get("metrics"))
+
+    def _telemetry_lost(self, bi: int, detail: str) -> None:
+        """The worker died with undelivered telemetry (its buffered
+        spans/events die with the process): declare the loss instead of
+        dropping it silently — an invisible device phase is exactly the
+        blind spot this machinery exists to close."""
+        from .obs import trace as obs_trace
+
+        if not obs_trace.active():
+            return  # worker was never tracing: nothing was lost
+        self._counter(
+            "engine_worker_telemetry_lost_total",
+            help="batches whose worker-side spans/events died with "
+                 "the worker before backhaul").inc()
+        self._event("worker_telemetry_lost", detail=detail, batch=bi)
+
     def _update_rss(self) -> None:
         try:
             with open(f"/proc/{self.proc.pid}/statm") as fh:
@@ -907,15 +956,21 @@ class WorkerSupervisor:
                         pass
             deadline = (time.monotonic() + self.batch_timeout
                         if self.batch_timeout is not None else None)
+            from .obs import trace as obs_trace
+
             try:
                 self._send({"op": "batch", "bi": int(bi),
                             "names": [str(x) for x in names],
                             "codes": [bytes(c) for c in codes],
                             "lanes": lanes, "width": width,
                             "on_cpu": bool(on_cpu or on_tier == "cpu"),
-                            "on_tier": on_tier})
+                            "on_tier": on_tier,
+                            "trace": obs_trace.context_snapshot()})
                 rep = self._read_frame(deadline)
             except TimeoutError:
+                self._telemetry_lost(
+                    bi, f"batch {bi} deadline; worker killed with "
+                        "its span buffer")
                 self._record_death(
                     f"batch {bi} exceeded {self.batch_timeout:.1f}s; "
                     "worker killed")
@@ -925,6 +980,9 @@ class WorkerSupervisor:
                     "killed)") from None
             except (EOFError, OSError):
                 rc = self._exit_code()
+                self._telemetry_lost(
+                    bi, f"worker died mid-batch {bi} (rc={rc}); span "
+                        "buffer lost with it")
                 self._record_death(f"worker died mid-batch {bi} (rc={rc})")
                 raise WorkerDied(
                     f"engine worker died mid-batch {bi} (rc={rc})"
@@ -937,7 +995,10 @@ class WorkerSupervisor:
                 raise self._rehydrate(rep)
             self._note_success()
             self._update_rss()
-            return rep["value"]
+            value = rep["value"]
+            if isinstance(value, dict):
+                self._absorb_telemetry(value.pop("telemetry", None), bi)
+            return value
 
 
 __all__ = [

@@ -233,6 +233,15 @@ class AnalysisDaemon:
     # --- lifecycle ------------------------------------------------------
     def start(self) -> None:
         obs_metrics.REGISTRY.enabled = True  # /metrics is always on
+        # serve is always-traced (docs/observability.md "Distributed
+        # tracing"): without an operator-installed tracer (--trace),
+        # install one on the data dir so /v1/trace, per-result timings
+        # and worker span backhaul work out of the box. Size rotation
+        # bounds the JSONL log for long-lived daemons.
+        self._own_tracer = None
+        if not obs_trace.active():
+            self._own_tracer = obs_trace.configure(
+                os.path.join(self.data_dir, "trace.json"))
         if self.solver_store:
             # resident campaigns run with solver_store=None, so the
             # daemon-installed store stays in force for every batch;
@@ -306,6 +315,10 @@ class AnalysisDaemon:
         self.state = "stopped"
         obs_trace.event("serve_stopped", reason=reason,
                         queued_failed=failed)
+        if (getattr(self, "_own_tracer", None) is not None
+                and obs_trace.get_tracer() is self._own_tracer):
+            obs_trace.close()
+            self._own_tracer = None
         self._done.set()
 
     def handle_signal(self, signum, frame=None) -> None:

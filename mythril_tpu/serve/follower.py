@@ -225,9 +225,13 @@ class ChainFollower:
         contracts = self._new_contracts(n)
         if not contracts:
             return True
+        # trace ingestion point: one trace id per ingested block (its
+        # contracts are one submission — one stitched timeline)
+        tid = obs_trace.new_trace_id()
         try:
             self.daemon.queue.submit(contracts, tenant=self.tenant,
-                                     priority=self.priority)
+                                     priority=self.priority,
+                                     trace_id=tid)
         except (QueueFull, QuotaExceeded):
             self._reg.counter(
                 "serve_follower_backpressure_total",
@@ -242,7 +246,8 @@ class ChainFollower:
             "serve_follower_ingested_total",
             help="newly deployed contracts submitted by the "
                  "follower").inc(len(contracts))
-        obs_trace.event("follower_ingest", block=n, n=len(contracts))
+        obs_trace.event("follower_ingest", block=n, n=len(contracts),
+                        trace_id=tid)
         return True
 
 

@@ -17,6 +17,12 @@ Endpoints (JSON in/out, no dependencies beyond ``http.server``):
   per contract result, written in COMMIT ORDER as batches land; the
   response ends when the submission completes. A slow or dead reader
   costs one daemon thread, nothing else (ThreadingHTTPServer).
+- ``GET /v1/trace/<trace_id>`` — the stitched span/event timeline of
+  one request trace (docs/observability.md "Distributed tracing"):
+  every record the daemon's bounded in-memory trace index holds for
+  that id, in monotonic order — including worker-subprocess spans
+  backhauled and clock-corrected by the supervisor. 404 when the id is
+  unknown or evicted.
 - ``GET /healthz`` — liveness + ``serving``/``draining`` state (a
   draining daemon answers, so orchestrators can distinguish "dying
   gracefully" from "dead").
@@ -133,6 +139,12 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._json(400, {"error": str(e)})
             return
+        # trace ingestion point: the transport mints the request trace
+        # id (or honors one a tracing client carried in), so the admit
+        # span and everything downstream share it
+        kw["trace_id"] = (str(doc.get("trace_id"))
+                          if doc.get("trace_id")
+                          else obs_trace.new_trace_id())
         try:
             sub = self.daemon.submit(contracts, **kw)
         except ValueError as e:
@@ -191,6 +203,20 @@ class _Handler(BaseHTTPRequestHandler):
             if wait > 0:
                 sub.wait_done(timeout=min(wait, 300.0))
             self._json(200, sub.snapshot())
+            return
+        if url.path.startswith("/v1/trace/"):
+            tid = url.path[len("/v1/trace/"):].strip("/")
+            recs = obs_trace.trace_records(tid)
+            if recs is None:
+                self._json(404, {"error": f"unknown trace {tid!r} "
+                                          "(expired from the index, "
+                                          "or never minted here)"})
+                return
+            self._json(200, {
+                "trace_id": tid,
+                "spans": sum(1 for r in recs
+                             if r.get("kind") == "span"),
+                "records": recs})
             return
         self._json(404, {"error": f"no such endpoint {url.path}"})
 

@@ -202,7 +202,7 @@ class Entry:
     __slots__ = ("eid", "name", "code", "bch", "cfh", "config",
                  "shape_key", "priority", "deadline", "seq", "state",
                  "result", "submission", "followers", "t_submit",
-                 "counted_inflight")
+                 "counted_inflight", "trace_id", "timings")
 
     def __init__(self, eid: str, name: str, code: bytes, config: Dict,
                  priority: int, deadline: Optional[float], seq: int,
@@ -225,6 +225,11 @@ class Entry:
         #: True while this FRESH entry holds one of its tenant's
         #: in-flight slots (queued or running; released at resolution)
         self.counted_inflight = False
+        #: request trace id (minted at the ingestion point) and the
+        #: per-stage latency ledger filled in as the entry moves
+        #: admission → schedule → device/host → commit
+        self.trace_id: Optional[str] = None
+        self.timings: Dict[str, float] = {}
 
     @property
     def uname(self) -> str:
@@ -297,6 +302,7 @@ class Submission:
                     "contracts": len(self.entries),
                     "completed": len(results),
                     "state": "done" if done else "pending",
+                    "trace_id": getattr(self, "trace_id", None),
                     "results": results}
 
 
@@ -483,17 +489,23 @@ class AdmissionQueue:
     def submit(self, contracts: Sequence[Tuple[str, bytes]],
                tenant: str = "default", priority: int = 0,
                deadline_sec: Optional[float] = None,
-               options: Optional[Dict] = None) -> Submission:
+               options: Optional[Dict] = None,
+               trace_id: Optional[str] = None) -> Submission:
         """Admit one submission of ``(name, bytecode)`` pairs. Raises
         :class:`QueueClosed` while draining, :class:`QuotaExceeded` on
         a per-tenant quota breach, :class:`QueueFull` when the whole
         submission cannot fit (all-or-nothing: a partially admitted
         submission would stream a partial result set that LOOKS
         complete). While shedding, a low-priority submission resolves
-        entirely at admission with store-only answers."""
+        entirely at admission with store-only answers. ``trace_id``
+        continues a trace the transport minted (HTTP handler,
+        follower); ``None`` mints one here — either way the id rides
+        every span, event, and verdict this submission produces."""
         config = self.config_fn(dict(options or {}))
-        with obs_trace.timer("admit", tenant=tenant,
-                             n=len(contracts)) as sp:
+        tid = trace_id or obs_trace.new_trace_id()
+        with obs_trace.trace_context(tid), \
+                obs_trace.timer("admit", tenant=tenant,
+                                n=len(contracts)) as sp:
             with self._cond:
                 if self.closed:
                     raise QueueClosed("daemon is draining")
@@ -506,6 +518,7 @@ class AdmissionQueue:
                     len(contracts))
                 sid = f"s{next(self._nsub):06d}-{os.getpid():x}"
                 sub = Submission(sid, tenant, self._cond)
+                sub.trace_id = tid
                 deadline = (None if deadline_sec is None
                             else now + float(deadline_sec))
                 if (self.shed_state == "shedding"
@@ -539,6 +552,8 @@ class AdmissionQueue:
                     e = Entry(f"e{next(self._seq):07d}", str(name),
                               bytes(code), config, int(priority),
                               deadline, next(self._seq), sub)
+                    e.trace_id = tid
+                    e.timings["admission"] = sp.elapsed
                     sub.entries.append(e)
                     key = (e.bch, e.cfh)
                     if self.dedupe:
@@ -660,13 +675,16 @@ class AdmissionQueue:
             now = time.monotonic()
             for e in batch:
                 e.state = "running"
-                obs_trace.complete("queue_wait", now - e.t_submit,
+                wait = now - e.t_submit
+                e.timings["sched_wait"] = max(
+                    0.0, wait - e.timings.get("admission", 0.0))
+                obs_trace.complete("queue_wait", wait,
                                    eid=e.eid, tenant=e.submission.tenant,
-                                   priority=e.priority)
+                                   priority=e.priority,
+                                   trace_id=e.trace_id)
                 self._reg.histogram(
                     "serve_queue_wait_seconds",
-                    help="admission-to-schedule latency").observe(
-                    now - e.t_submit)
+                    help="admission-to-schedule latency").observe(wait)
             self._depth_gauge()
             return batch
 
@@ -683,10 +701,30 @@ class AdmissionQueue:
         res["config_hash"] = e.cfh
         if served_from:
             res["served_from"] = served_from
+        # --- per-stage latency attribution (docs/observability.md):
+        # the entry's stage ledger + total, rounded for the wire; the
+        # stage histograms feed the heartbeat's req p50/p95 token
+        now = time.monotonic()
+        total = now - e.t_submit
+        tm = dict(e.timings)
+        tm["total"] = total
+        res["timings"] = {k: round(v, 6) for k, v in tm.items()}
+        if e.trace_id:
+            res["trace_id"] = e.trace_id
+        self._reg.histogram(
+            "serve_request_seconds",
+            help="end-to-end request latency (submit to "
+                 "resolve)").observe(total)
+        for stage in ("admission", "sched_wait", "device", "host",
+                      "commit"):
+            if stage in e.timings:
+                self._reg.histogram(
+                    "serve_request_stage_seconds",
+                    help="per-request latency by pipeline stage",
+                    labels={"stage": stage}).observe(e.timings[stage])
         e.result = res
         e.submission.results.append(res)
         # --- per-tenant SLO ledger (docs/serving.md) ---
-        now = time.monotonic()
         st = self._tenant_locked(e.submission.tenant)
         st.completed += 1
         st.lat_sum += now - e.t_submit
@@ -708,6 +746,7 @@ class AdmissionQueue:
                         status=res.get("status"),
                         served_from=served_from,
                         deadline_hit=deadline_hit,
+                        trace_id=e.trace_id,
                         wait=round(now - e.t_submit, 4))
         for f in e.followers:
             self._resolve_locked(f, self._verdict_result(f, res),

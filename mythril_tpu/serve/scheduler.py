@@ -273,10 +273,29 @@ class Scheduler:
             warm = bool(camp.shape_is_warm())
         items = [(e.uname, e.code) for e in entries]
         tenants = sorted({e.submission.tenant for e in entries})
-        with obs_trace.span("schedule", n=len(entries),
-                            cfh=entries[0].cfh, warm=warm,
-                            tenants=tenants):
+        # one batch may serve several requests: the first entry's
+        # trace_id leads the scope, the rest ride as link ids — every
+        # span below (campaign, worker, solver) indexes under ALL of
+        # them, so each request's /v1/trace view is complete
+        ids: List[str] = []
+        for e in entries:
+            if e.trace_id and e.trace_id not in ids:
+                ids.append(e.trace_id)
+        ids = ids or [obs_trace.new_trace_id()]
+        with obs_trace.trace_context(ids[0], link_ids=ids[1:]), \
+                obs_trace.span("schedule", n=len(entries),
+                               cfh=entries[0].cfh, warm=warm,
+                               tenants=tenants):
             out = camp.run_external_batch(items)
+        # stage attribution: each entry waited through the whole batch
+        # device + host phases, so the batch totals ARE its stage costs
+        ph = out.get("phases") if isinstance(out, dict) else None
+        if isinstance(ph, dict):
+            for e in entries:
+                for k in ("device", "host"):
+                    v = float(ph.get(k) or 0.0)
+                    if v:
+                        e.timings[k] = v
         self.batches_run += 1
         self._reg.counter(
             "serve_batches_total",
@@ -320,17 +339,35 @@ class Scheduler:
                 my.append(i)
             verdict = {"status": "ok", "issues": my,
                        "batch_status": batch_status}
+            if e.trace_id:
+                # provenance: the stored verdict names the request
+                # trace that computed it (dedupe-served copies keep it)
+                verdict["trace_id"] = e.trace_id
             if self.store is not None and self.queue.dedupe:
+                t0 = time.monotonic()
                 self.store.put(e.bch, e.cfh, verdict)
+                e.timings["commit"] = time.monotonic() - t0
+                obs_trace.event("verdict_commit", eid=e.eid,
+                                bch=e.bch, trace_id=e.trace_id,
+                                dur=round(e.timings["commit"], 6))
             res = dict(verdict)
             res["batch"] = batch
             self.queue.resolve(e, res)
 
     # --- fleet-fed execution (docs/fleet.md) ----------------------------
     def _feed_batch(self, entries: List[Entry]) -> None:
+        # the unit config carries the requests' trace ids across the
+        # ledger: the claiming worker re-enters the same trace scope
+        # (campaign._run_unit), so remote spans join these requests
+        cfg = dict(entries[0].config)
+        ids: List[str] = []
+        for e in entries:
+            if e.trace_id and e.trace_id not in ids:
+                ids.append(e.trace_id)
+        if ids:
+            cfg["trace"] = {"ids": ids}
         uid = self._ledger.feed_unit(
-            [(e.uname, e.code) for e in entries],
-            config=entries[0].config)
+            [(e.uname, e.code) for e in entries], config=cfg)
         self._pending[uid] = entries
         self._reg.counter(
             "serve_fleet_units_fed_total",

@@ -59,7 +59,7 @@ def bytecode_hash(code: bytes) -> str:
 OPERATIONAL_KEYS = frozenset((
     "fault_inject", "batch_timeout", "max_batch_retries", "oom_ladder",
     "solver_workers", "batch_size", "worker_isolation",
-    "backend_tiers"))
+    "backend_tiers", "trace"))
 
 
 def config_hash(config: Dict) -> str:

@@ -58,6 +58,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from . import solver as _sv
 from .canon import (CanonicalQuery, canonical_query, witness_from_doc,
                     witness_ok, witness_to_doc)
@@ -219,6 +220,13 @@ def _stage_end(stage: str, t0: float,
         PORTFOLIO_STATS.hit(stage, verdict)
         obs_metrics.REGISTRY.counter(
             f"solver_hits_stage_{stage}_total").inc()
+        # one instant event per DECIDED query (not per attempted
+        # stage): carries the ambient trace_id, so a request's trace
+        # shows which ladder stage settled each of its queries —
+        # volume-bounded by queries, not stages
+        if obs_trace.active():
+            obs_trace.event("solver_stage", stage=stage,
+                            dur=round(dt, 6), verdict=verdict)
 
 
 def _lru_get(key):

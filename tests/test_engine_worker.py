@@ -44,6 +44,10 @@ def test_stub_worker_roundtrip_and_rss():
     sup = stub_supervisor()
     try:
         out = sup.run_batch(0, ["a", "b"], [b"\x00", b"\x01"])
+        # the reply carries the child's stage attribution (host-phase
+        # seconds; device = parent wall - host, computed campaign-side)
+        ph = out.pop("phases")
+        assert set(ph) == {"device", "host"} and ph["host"] >= 0.0
         assert out == {"issues": [], "paths": 2, "dropped": 0,
                        "iprof": {}}
         st = sup.status()

@@ -8,6 +8,7 @@ budget pays no XLA compile for observability coverage.
 import importlib.util
 import json
 import os
+import re
 import time
 
 import pytest
@@ -115,6 +116,176 @@ def test_jsonl_path_derivation():
     assert obs_trace.jsonl_path_for("out/trace") == "out/trace.jsonl"
 
 
+# --- distributed tracing ----------------------------------------------
+
+
+def test_trace_context_stamps_and_indexes(tmp_path):
+    """Inside a trace_context scope every span/event is stamped with
+    trace_id + span/parent linkage, lands in the bounded trace index
+    under EVERY linked id, and reads back in monotonic order
+    (docs/observability.md "Distributed tracing")."""
+    obs_trace.configure(str(tmp_path / "t.json"))
+    tid, other = "a" * 16, "b" * 16
+    assert obs_trace.trace_records(tid) is None
+    with obs_trace.trace_context(tid, link_ids=[other]):
+        assert obs_trace.current_trace_id() == tid
+        with obs_trace.span("schedule", bi=0):
+            obs_trace.event("verdict_commit", eid="e0")
+    assert obs_trace.current_trace_id() is None    # scope exited
+    recs = obs_trace.trace_records(tid)
+    assert recs is not None
+    sp = next(r for r in recs if r["kind"] == "span")
+    ev = next(r for r in recs if r["kind"] == "verdict_commit")
+    assert sp["trace_id"] == tid and ev["trace_id"] == tid
+    # the event nested under the span links to it as parent
+    assert ev["parent"] == sp["span"]
+    # the linked (batched-together) request indexes the same records
+    assert obs_trace.trace_records(other)
+    monos = [r["mono"] for r in recs]
+    assert monos == sorted(monos)
+
+
+def test_context_snapshot_roundtrip(tmp_path):
+    """The snapshot/apply pair that crosses thread and IPC boundaries
+    reproduces the scope verbatim; apply(None) is a no-op guard."""
+    with obs_trace.trace_context("c" * 16, link_ids=["d" * 16]):
+        snap = obs_trace.context_snapshot()
+    assert snap["ids"] == ["c" * 16, "d" * 16]
+    assert obs_trace.context_snapshot() is None
+    with obs_trace.apply_context(snap):
+        assert obs_trace.current_trace_id() == "c" * 16
+    with obs_trace.apply_context(None):
+        assert obs_trace.current_trace_id() is None
+
+
+def test_worker_clock_stitch_monotone(tmp_path):
+    """Backhauled worker records carry the CHILD's monotonic clock;
+    re-emission with the spawn-handshake offset must land them on the
+    parent timeline — after the parent span that contains them, in
+    child order — even under an arbitrarily skewed child clock."""
+    obs_trace.configure(str(tmp_path / "t.json"))
+    tid = "e" * 16
+    with obs_trace.trace_context(tid):
+        with obs_trace.span("schedule", bi=0):
+            # fake child: its monotonic clock reads ~5.0 while the
+            # parent's reads time.monotonic() — wildly skewed
+            child = [
+                {"schema": 1, "kind": "span", "name": "device_phase",
+                 "t": 123.0, "mono": 5.0, "dur": 0.25, "tid": 1,
+                 "session": "fakewkr", "bi": 0, "trace_id": tid},
+                {"schema": 1, "kind": "solver_stage", "t": 123.3,
+                 "mono": 5.3, "session": "fakewkr", "stage": "lru",
+                 "verdict": "unsat", "trace_id": tid},
+            ]
+            offset = time.monotonic() - 5.0   # the supervisor handshake
+            n = obs_trace.reemit_records(child, mono_offset=offset,
+                                         proc="worker", wpid=1234)
+    obs_trace.close()
+    assert n == 2
+    recs = obs_trace.trace_records(tid)
+    worker = [r for r in recs if r.get("proc") == "worker"]
+    assert len(worker) == 2
+    # transport meta was re-stamped; the child session survives as
+    # provenance, not as the ordering key
+    assert all(r["src_session"] == "fakewkr" for r in worker)
+    assert all(r["session"] != "fakewkr" for r in worker)
+    # ONE monotone timeline on the parent clock: the worker device
+    # span starts after the parent schedule span that dispatched it
+    monos = [r["mono"] for r in recs]
+    assert monos == sorted(monos)
+    sched = next(r for r in recs if r.get("name") == "schedule")
+    dev = next(r for r in recs if r.get("name") == "device_phase")
+    stage = next(r for r in recs if r["kind"] == "solver_stage")
+    assert sched["mono"] <= dev["mono"] <= stage["mono"]
+
+
+def test_jsonl_rotation_set_aside_and_byte_gauge(tmp_path):
+    """Crossing the size cap rotates the live log to ``.1`` (one
+    set-aside generation), opens the fresh log with a
+    ``trace_log_rotated`` seam record, ticks the rotation counter and
+    keeps the obs_event_log_bytes gauge on the live file."""
+    jl = str(tmp_path / "t.jsonl")
+    obs_trace.configure(str(tmp_path / "t.json"), max_jsonl_bytes=600)
+    for i in range(30):
+        obs_trace.event("heartbeat", batch=i, pad="x" * 40)
+    snap = obs_metrics.REGISTRY.snapshot()
+    assert snap["counters"]["obs_event_log_rotations_total"] >= 1
+    assert os.path.exists(jl + ".1")
+    assert read_jsonl(jl + ".1")                   # parseable prefix
+    live = read_jsonl(jl)
+    assert live[0]["kind"] == "trace_log_rotated"
+    assert live[0]["rotated_bytes"] >= 600
+    assert live[0]["set_aside"] == jl + ".1"
+    assert snap["gauges"]["obs_event_log_bytes"] == os.path.getsize(jl)
+    obs_trace.close()
+
+
+def test_worker_buffer_drain_and_drop_counter():
+    """Buffer-mode (engine-worker) tracer: records accumulate for the
+    batch-reply drain and touch no files; a record arriving after
+    close is DECLARED via obs_events_dropped_total, never silent."""
+    tr = obs_trace.configure(buffer=True)
+    with obs_trace.trace_context("f" * 16):
+        obs_trace.event("solver_stage", stage="lru", verdict="unsat")
+    recs = tr.drain_buffer()
+    assert len(recs) == 1 and recs[0]["trace_id"] == "f" * 16
+    assert tr.drain_buffer() == []                 # drained
+    tr.close()
+    obs_trace.event("heartbeat", batch=1)
+    snap = obs_metrics.REGISTRY.snapshot()
+    assert snap["counters"]["obs_events_dropped_total"] == 1.0
+
+
+# --- schema lint: source scan vs naming rules and the docs table ------
+
+_METRIC_CALL = re.compile(
+    r'(?:counter|gauge|histogram)\(\s*[\'"]([A-Za-z0-9_]+)[\'"]')
+_EVENT_CALL = re.compile(r'\b_?event\(\s*[\'"]([A-Za-z0-9_]+)[\'"]')
+_PROM_NAME = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _scan_sources():
+    """Every metric-name and event-kind literal in the package (the
+    regexes span the multi-line call style used everywhere)."""
+    metrics, events = set(), set()
+    for dirpath, _dirs, files in os.walk(os.path.join(ROOT,
+                                                      "mythril_tpu")):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as fh:
+                src = fh.read()
+            metrics.update(_METRIC_CALL.findall(src))
+            events.update(_EVENT_CALL.findall(src))
+    return metrics, events
+
+
+def test_metric_names_follow_prometheus_conventions():
+    metrics, _ = _scan_sources()
+    assert len(metrics) > 40                       # the scan works
+    bad = sorted(m for m in metrics
+                 if not _PROM_NAME.match(m) or "__" in m
+                 or m.endswith("_"))
+    assert not bad, f"metric names violating prometheus naming: {bad}"
+
+
+def test_every_event_kind_is_documented():
+    """Every emitted event ``kind`` must appear (backticked) in
+    docs/observability.md's schema table — adding an event without
+    documenting it fails here."""
+    _, events = _scan_sources()
+    # dynamic prefix concatenations (event("tier_" + kind)) scan as
+    # the prefix; their concrete kinds also appear as literals
+    events = {e for e in events if not e.endswith("_")}
+    events.add("trace_log_rotated")    # written inline at the seam
+    with open(os.path.join(ROOT, "docs", "observability.md"),
+              encoding="utf-8") as fh:
+        doc = fh.read()
+    missing = sorted(k for k in events if f"`{k}`" not in doc)
+    assert not missing, ("event kinds missing from "
+                         f"docs/observability.md: {missing}")
+
+
 # --- metrics ----------------------------------------------------------
 
 
@@ -181,6 +352,46 @@ def test_metrics_write_json_and_prom(tmp_path):
     reg.write(p)
     assert json.load(open(j))["counters"]["c"] == 1.0
     assert "mythril_c 1" in open(p).read()
+
+
+def test_histogram_quantile():
+    reg = obs_metrics.MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 0.5, 1.0))
+    assert h.quantile(0.5) is None                 # empty
+    for v in (0.05, 0.2, 0.3, 0.8):
+        h.observe(v)
+    # bucket-walk estimate, clamped to the observed max
+    assert h.quantile(0.5) == 0.5
+    assert h.quantile(0.95) == 0.8
+
+
+def test_metrics_delta_roundtrip():
+    """snapshot_delta/apply_delta — the worker-telemetry metrics
+    backhaul: only what changed crosses the IPC boundary, and folding
+    it into the parent registry reproduces the increments."""
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("c_total").inc(2)
+    h = reg.histogram("h_seconds", buckets=(1.0,))
+    h.observe(0.5)
+    before = reg.snapshot()
+    reg.counter("c_total").inc(3)
+    h.observe(2.0)
+    reg.gauge("g").set(7)
+    delta = obs_metrics.snapshot_delta(reg.snapshot(), before)
+    assert delta["counters"] == {"c_total": 3.0}
+    dst = obs_metrics.MetricsRegistry()
+    dst.histogram("h_seconds", buckets=(1.0,))     # same shape
+    obs_metrics.apply_delta(delta, dst)
+    snap = dst.snapshot()
+    assert snap["counters"]["c_total"] == 3.0
+    assert snap["gauges"]["g"] == 7.0
+    hs = snap["histograms"]["h_seconds"]
+    assert hs["count"] == 1 and hs["sum"] == 2.0
+    assert hs["buckets"] == {"1.0": 0, "+Inf": 1}
+    # an unchanged registry produces an EMPTY delta
+    again = reg.snapshot()
+    d2 = obs_metrics.snapshot_delta(again, again)
+    assert not d2["counters"] and not d2["histograms"]
 
 
 # --- campaign integration (stub runner — no engine) -------------------
@@ -322,3 +533,29 @@ def test_trace_report_summarizes_both_formats(tmp_path, capsys):
         assert "halve-lanes" in out                # degrade timeline row
         assert "checkpoint_save" in out or "saves:" in out
     assert tr.main([str(tmp_path / "nope.json")]) == 2
+
+
+def test_trace_report_cross_process_timeline(tmp_path, capsys):
+    """Section 10 regroups trace_id-stamped records per request and
+    renders worker-side records (backhauled spans) as [worker] rows in
+    one monotone timeline."""
+    obs_trace.configure(str(tmp_path / "t.json"))
+    tid = "9" * 16
+    with obs_trace.trace_context(tid):
+        with obs_trace.span("schedule", bi=0):
+            obs_trace.reemit_records(
+                [{"schema": 1, "kind": "span", "name": "device_phase",
+                  "t": 1.0, "mono": 0.5, "dur": 0.2,
+                  "session": "fakewkr", "trace_id": tid}],
+                mono_offset=time.monotonic() - 0.5, proc="worker")
+        obs_trace.event("verdict_commit", eid="e0")
+    obs_trace.close()
+    tr = _load_trace_report()
+    assert tr.main([str(tmp_path / "t.jsonl")]) == 0
+    out = capsys.readouterr().out
+    assert "cross-process timeline" in out
+    assert f"trace {tid}" in out
+    assert "[worker]" in out and "device_phase" in out
+    assert "verdict_commit" in out
+    # the per-stage totals table names the parent-side span too
+    assert "schedule" in out

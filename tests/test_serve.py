@@ -517,6 +517,105 @@ def test_e2e_dedupe_and_warm_compile_real_engine(tmp_path):
     assert dm.state == "stopped"
 
 
+# --- cross-process request tracing (docs/observability.md) --------------
+
+def _get_trace(url, tid):
+    with urllib.request.urlopen(f"{url}/v1/trace/{tid}",
+                                timeout=30.0) as resp:
+        return json.load(resp)
+
+
+def test_e2e_cross_process_trace_with_worker_kill(tmp_path):
+    """The distributed-tracing acceptance path (ISSUE 14): with worker
+    isolation ON, one trace_id stitches HTTP submit -> admission ->
+    scheduler -> campaign batch -> worker-subprocess spans (backhauled
+    over the batch IPC, clock-corrected) -> verdict commit into ONE
+    monotone timeline served by /v1/trace — including a worker KILLED
+    mid-batch, whose undelivered span buffer is declared lost
+    (worker_telemetry_lost) before the retry's fresh worker ships the
+    replay's telemetry. Per-result timings sum to the request wall."""
+    from mythril_tpu.config import TEST_LIMITS
+    from mythril_tpu.mythril.campaign import CorpusCampaign
+    from mythril_tpu.resilience import (FaultInjector, FaultSpec,
+                                        WorkerSupervisor)
+
+    from mythril_tpu.obs import trace as obs_trace
+    assert not obs_trace.active()      # the daemon must own the tracer
+
+    inj = FaultInjector([FaultSpec.parse("worker-kill:nth=1")])
+    sup = WorkerSupervisor(stub=True, batch_timeout=30.0,
+                           backoff_base=0.01, spawn_timeout=60.0,
+                           fault_injector=inj)
+    camp = CorpusCampaign([], limits=TEST_LIMITS, batch_size=4,
+                          lanes_per_contract=4, max_steps=16,
+                          worker_isolation="on", worker_supervisor=sup,
+                          fault_injector=inj)
+    lost0 = counter("engine_worker_telemetry_lost_total")
+    dm = AnalysisDaemon(data_dir=str(tmp_path / "sd"), port=0,
+                        options=ServeOptions(batch_size=4),
+                        campaign_factory=lambda cfg: camp)
+    dm.start()
+    url = f"http://127.0.0.1:{dm.port}"
+    try:
+        assert obs_trace.active()      # auto-tracer without --trace
+        snap = _submit(url, [("a", b"\x00aa"), ("b", b"\x00bb")])
+        res = serve_client.get_result(url, snap["id"], wait=60.0)
+        assert res["state"] == "done"
+
+        # the batch survived the mid-batch worker kill via retry, and
+        # the first worker's undelivered telemetry was DECLARED lost
+        assert counter("engine_worker_telemetry_lost_total") - lost0 >= 1
+
+        # one trace id for the whole submission, echoed per result
+        assert res["trace_id"]
+        tid = res["trace_id"]
+        assert all(r["trace_id"] == tid for r in res["results"])
+
+        doc = _get_trace(url, tid)
+        assert doc["trace_id"] == tid and doc["spans"] >= 3
+        recs = doc["records"]
+        # every record of the stitched view belongs to this trace
+        assert all(r.get("trace_id") == tid
+                   or tid in (r.get("trace_ids") or ()) for r in recs)
+        # ... in ONE monotone timeline
+        monos = [r["mono"] for r in recs]
+        assert monos == sorted(monos)
+        # ... spanning >= 2 processes: the daemon's own records plus
+        # worker-subprocess spans backhauled over the batch IPC
+        worker = [r for r in recs if r.get("proc") == "worker"]
+        assert worker, "no worker-side records in the stitched trace"
+        parent_sessions = {r["session"] for r in recs}
+        assert all(r["src_session"] not in parent_sessions
+                   for r in worker)
+        assert any(r.get("name") == "device_phase" for r in worker)
+        names = {r.get("name") for r in recs if r["kind"] == "span"}
+        kinds = {r["kind"] for r in recs}
+        assert {"admit", "queue_wait", "schedule"} <= names
+        assert "verdict_commit" in kinds
+        assert "worker_telemetry_lost" in kinds   # the kill, declared
+
+        # per-stage attribution: stages sum to the request wall
+        for r in res["results"]:
+            tm = r["timings"]
+            assert set(tm) >= {"admission", "sched_wait", "device",
+                               "commit", "total"}
+            stages = sum(v for k, v in tm.items() if k != "total")
+            assert abs(stages - tm["total"]) <= max(
+                0.10 * tm["total"], 0.05), tm
+        # the end-to-end histogram powering the heartbeat's req token
+        rh = obs_metrics.REGISTRY.histogram("serve_request_seconds")
+        assert rh.count >= 2 and rh.quantile(0.95) is not None
+
+        # an unknown id is a 404, not an empty timeline
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_trace(url, "0" * 16)
+        assert ei.value.code == 404
+    finally:
+        dm.shutdown("test")
+        sup.close()
+    assert not obs_trace.active()      # daemon closed its own tracer
+
+
 # --- scheduler crash containment (docs/resilience.md) -------------------
 
 def test_scheduler_crash_fails_pending_and_degrades_health(

@@ -172,6 +172,20 @@ def percentiles(xs: Sequence[float]) -> Dict[str, float]:
             "p99": round(pct(0.99), 4), "max": round(s[-1], 4)}
 
 
+def stage_percentiles(results: Sequence[Dict]) -> Dict[str, Dict]:
+    """Per-stage latency percentiles aggregated from the per-result
+    ``timings`` blocks the daemon attaches (docs/observability.md
+    "Distributed tracing"): where did the request's wall time go —
+    admission, scheduler wait, device, host/solver, or verdict
+    commit."""
+    by_stage: Dict[str, List[float]] = {}
+    for r in results:
+        for stage, sec in (r.get("timings") or {}).items():
+            by_stage.setdefault(stage, []).append(float(sec))
+    return {stage: percentiles(xs)
+            for stage, xs in sorted(by_stage.items())}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", required=True,
@@ -274,6 +288,7 @@ def main() -> int:
                     if r.get("status") == "shed"),
         "submit_sec": round(t_submit, 4),
         "latency": percentiles(lat),
+        "stages": stage_percentiles(results),
         "results": results,
     }
     print(json.dumps(out, indent=1))

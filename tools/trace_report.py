@@ -77,10 +77,12 @@ def _from_chrome(events: List[Dict]) -> Tuple[List[Dict], List[Dict]]:
         if ph == "X":
             spans.append({"name": e.get("name", "?"),
                           "dur": float(e.get("dur", 0.0)) / 1e6,
+                          "mono": float(e.get("ts", 0.0)) / 1e6,
                           "args": e.get("args", {}) or {}})
         elif ph == "i":
             instants.append({"kind": e.get("name", "?"),
                              "t": float(e.get("ts", 0.0)) / 1e6,
+                             "mono": float(e.get("ts", 0.0)) / 1e6,
                              "args": e.get("args", {}) or {}})
     return spans, instants
 
@@ -90,14 +92,18 @@ def _from_jsonl(lines: List[Dict]) -> Tuple[List[Dict], List[Dict]]:
     meta = {"schema", "kind", "name", "t", "mono", "dur", "tid", "session"}
     for e in lines:
         args = {k: v for k, v in e.items() if k not in meta}
+        mono = e.get("mono", 0.0)
+        mono = float(mono) if isinstance(mono, (int, float)) else 0.0
         if e.get("kind") == "span":
             spans.append({"name": e.get("name", "?"),
-                          "dur": float(e.get("dur", 0.0)), "args": args})
+                          "dur": float(e.get("dur", 0.0)),
+                          "mono": mono, "args": args})
         else:
             t = e.get("t", 0.0)
             instants.append({"kind": e.get("kind", "?"),
                              "t": float(t) if isinstance(t, (int, float))
                              else 0.0,
+                             "mono": mono,
                              "args": args})
     return spans, instants
 
@@ -382,6 +388,69 @@ def report(spans: List[Dict], instants: List[Dict], top: int = 10) -> str:
                     f"{int(r['dl_miss']):>8}{_fmt_s(mean):>11}")
     else:
         out.append("(no serve admission events — not a serve trace?)")
+
+    # 10. cross-process timeline / request critical path
+    # (docs/observability.md "Distributed tracing"): every record that
+    # carries a trace_id, regrouped per request and rendered in
+    # monotonic order — including worker-subprocess spans the
+    # supervisor backhauled and clock-corrected, marked [worker].
+    by_trace: Dict[str, List[Dict]] = {}
+    for s in spans:
+        tid = s["args"].get("trace_id")
+        if tid:
+            by_trace.setdefault(str(tid), []).append(
+                {"mono": s.get("mono", 0.0), "what": s["name"],
+                 "dur": s["dur"], "args": s["args"], "span": True})
+    for e in instants:
+        tid = e["args"].get("trace_id")
+        if tid:
+            by_trace.setdefault(str(tid), []).append(
+                {"mono": e.get("mono", 0.0), "what": e["kind"],
+                 "dur": None, "args": e["args"], "span": False})
+    out.append("")
+    out.append("== cross-process timeline / request critical path ==")
+    if by_trace:
+        # per-stage totals across every traced request: where request
+        # wall time went, fleet-wide
+        stage_tot: Dict[str, List[float]] = {}
+        for recs in by_trace.values():
+            for r in recs:
+                if r["span"]:
+                    stage_tot.setdefault(r["what"], []).append(r["dur"])
+        out.append(f"traces: {len(by_trace)}   stage totals:")
+        out.append(f"{'stage':<18}{'count':>7}{'total':>10}{'mean':>10}")
+        for name, durs in sorted(stage_tot.items(),
+                                 key=lambda kv: -sum(kv[1])):
+            out.append(f"{name:<18}{len(durs):>7}"
+                       f"{_fmt_s(sum(durs)):>10}"
+                       f"{_fmt_s(sum(durs) / len(durs)):>10}")
+        # the most recent few requests, each as one stitched timeline
+        recent = sorted(by_trace.items(),
+                        key=lambda kv: max(r["mono"] for r in kv[1]))
+        shown = recent[-min(8, max(1, top)):]
+        if len(recent) > len(shown):
+            out.append(f"(showing the {len(shown)} most recent of "
+                       f"{len(recent)} traces)")
+        for tid, recs in shown:
+            recs.sort(key=lambda r: r["mono"])
+            nproc = len({(r["args"].get("proc"),
+                          r["args"].get("src_session"))
+                         for r in recs})
+            wk = sum(1 for r in recs
+                     if r["args"].get("proc") == "worker")
+            out.append("")
+            out.append(f"-- trace {tid} ({len(recs)} records, "
+                       f"{nproc} process(es), {wk} worker-side) --")
+            t0 = recs[0]["mono"]
+            for r in recs:
+                proc = ("worker" if r["args"].get("proc") == "worker"
+                        else "  -   ")
+                d = f"  {_fmt_s(r['dur']).strip()}" if r["span"] else ""
+                out.append(f"+{r['mono'] - t0:8.3f}s [{proc}] "
+                           f"{r['what']}{d}")
+    else:
+        out.append("(no trace_id-stamped records — pre-tracing run, or "
+                   "no requests traversed this process)")
     return "\n".join(out)
 
 
