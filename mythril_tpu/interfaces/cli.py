@@ -412,6 +412,35 @@ def create_parser() -> argparse.ArgumentParser:
                     metavar="SEC",
                     help="follower poll cadence at the chain head "
                          "(default 2.0)")
+    sv.add_argument("--backfill", metavar="RPC_URI",
+                    help="whole-chain backfill: walk history BACKWARD "
+                         "from the head anchored at first start, "
+                         "ingesting every deployed contract as the "
+                         "standing tenant 'backfill' at the lowest "
+                         "priority of all (below the follower, shed "
+                         "first); resumes from a durable two-ended "
+                         "cursor in --data-dir")
+    sv.add_argument("--backfill-window", type=int, default=64,
+                    metavar="N",
+                    help="blocks per backfill scan window; the cursor "
+                         "advances only past fully-committed windows, "
+                         "so a kill re-scans at most N blocks "
+                         "(default 64)")
+    sv.add_argument("--compact-every", type=float, default=None,
+                    metavar="SEC",
+                    help="background store compaction period: fold "
+                         "settled loose verdict files into immutable "
+                         "checksummed segments behind a "
+                         "generation-numbered manifest "
+                         "(docs/serving.md 'Verdict segments & edge "
+                         "replicas'); run on at most ONE replica per "
+                         "data dir (default: off)")
+    sv.add_argument("--store-only", action="store_true",
+                    help="edge replica mode: serve dedupe-store "
+                         "answers only, NO engine — store misses get "
+                         "a typed unknown-contract answer with "
+                         "Retry-After; the manifest snapshot is "
+                         "re-polled for new generations")
     sv.add_argument("--drain-timeout", type=float, default=30.0,
                     metavar="SEC",
                     help="SIGTERM drain budget: how long the in-flight "
@@ -1011,7 +1040,11 @@ def exec_serve(args) -> int:
         solver_store=(None if args.no_solver_store
                       else (args.solver_store or "auto")),
         quotas=quotas or None, default_quota=default_quota, shed=shed,
-        follow_uri=args.follow, follow_poll=args.follow_poll)
+        follow_uri=args.follow, follow_poll=args.follow_poll,
+        backfill_uri=args.backfill,
+        backfill_window=args.backfill_window,
+        compact_every=args.compact_every,
+        store_only=args.store_only)
     daemon.install_signal_handlers()
     try:
         daemon.start()

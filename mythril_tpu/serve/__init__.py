@@ -32,25 +32,38 @@ The pieces:
 - :mod:`serve.follower` — chain-head follower (`serve --follow URI`):
   ingests newly deployed contracts as a standing lowest-priority
   tenant, shed first under overload;
+- :mod:`serve.segstore` — compacted verdict segments: a background
+  compactor folds settled loose verdicts into immutable,
+  content-addressed segment files behind a generation-numbered
+  manifest, so read cost stops scaling with ``os.listdir``;
+- :mod:`serve.backfill` — whole-chain backfill (`serve --backfill
+  URI`): a backward window walker with a durable two-ended cursor,
+  submitting history as the lowest-priority tenant of all;
 - :mod:`serve.daemon` — lifecycle: wiring, signal handling, graceful
   drain (SIGTERM finishes the in-flight batch, persists its verdicts,
   rejects new submissions with 503, then exits — a restart serves the
-  finished work from the store, exactly once).
+  finished work from the store, exactly once). ``--store-only`` runs
+  it as an engine-free edge replica serving dedupe-store answers from
+  a manifest snapshot.
 
 Import cost is stdlib-only until the first batch actually runs (the
 engine loads lazily inside the scheduler), mirroring the campaign CLI's
 backend-free front door.
 """
 
+from .backfill import BACKFILL_PRIORITY, ChainBackfill
 from .daemon import AnalysisDaemon, ServeOptions
 from .follower import FOLLOWER_PRIORITY, ChainFollower
 from .queue import (AdmissionQueue, Entry, QueueClosed, QueueFull,
                     QuotaExceeded, ShedPolicy, Submission, TenantQuota)
-from .scheduler import Scheduler
+from .scheduler import Scheduler, StoreOnlyScheduler
+from .segstore import SegmentStore
 from .store import ResultsStore, bytecode_hash, config_hash
 
-__all__ = ["AdmissionQueue", "AnalysisDaemon", "ChainFollower",
-           "Entry", "FOLLOWER_PRIORITY", "QueueClosed", "QueueFull",
+__all__ = ["AdmissionQueue", "AnalysisDaemon", "BACKFILL_PRIORITY",
+           "ChainBackfill", "ChainFollower", "Entry",
+           "FOLLOWER_PRIORITY", "QueueClosed", "QueueFull",
            "QuotaExceeded", "ResultsStore", "Scheduler",
-           "ServeOptions", "ShedPolicy", "Submission", "TenantQuota",
+           "SegmentStore", "ServeOptions", "ShedPolicy",
+           "StoreOnlyScheduler", "Submission", "TenantQuota",
            "bytecode_hash", "config_hash"]

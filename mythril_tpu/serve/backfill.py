@@ -1,0 +1,285 @@
+"""Whole-chain backfill: a backward historical walker feeding the
+verdict index (docs/serving.md "Verdict segments & edge replicas").
+
+``serve --backfill RPC_URI`` runs this loop beside the scheduler (and
+usually beside ``--follow``, which covers the head while this covers
+history): anchor ``hi`` at the current chain head once, then walk
+BACKWARD in windows of ``--backfill-window`` blocks, scanning each
+block for contract creations with the same deployment-scan the
+follower uses (``serve/follower.deployed_contracts``), and submitting
+every discovered bytecode as the standing tenant ``backfill`` at
+:data:`BACKFILL_PRIORITY` — below even the follower, BY DESIGN the
+first workload shed and the last scheduled. Combined with clone/proxy
+dominance and the dedupe store, this converges on "the index already
+knows every mainnet contract".
+
+Contracts:
+
+- **two-ended durable cursor** — ``<data-dir>/backfill_cursor.json``
+  holds ``{lo, hi}``: ``hi`` is the head anchored at FIRST start
+  (fixed — the follower owns everything after it), ``lo`` is the
+  lowest block whose window has fully committed. Fresh cursor starts
+  at ``lo = hi + 1``; the walk is done when ``lo == 0``.
+- **exactly-once per window** — the cursor only moves past a window
+  after EVERY contract in it resolved through the queue
+  (analyzed-or-deduped, statuses checked). A SIGKILL mid-window means
+  the restart re-scans at most that one window, and the dedupe store
+  makes the overlap free (re-submissions are store hits).
+- **bounded backoff with jitter** — RPC failures double a capped
+  backoff with multiplicative jitter and tick
+  ``serve_backfill_rpc_errors_total``; N backfilling replicas won't
+  stampede a recovering node.
+- **backpressure, not pressure** — a full queue or spent quota leaves
+  the cursor unmoved and retries the window at the poll cadence.
+- **visibility** — ``serve_backfill_remaining_blocks`` /
+  ``serve_backfill_ingested_total`` / ``serve_backfill_rpc_errors_total``
+  in ``/metrics``; ``/healthz`` carries a ``backfill`` block; one
+  trace id is minted per window (docs/observability.md) so a window's
+  contracts share a stitched timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..utils.checkpoint import durable_write
+from .follower import deployed_contracts
+from .queue import QueueClosed, QueueFull, QuotaExceeded
+
+log = logging.getLogger(__name__)
+
+#: the backfill tenant's fixed priority: below even the follower
+#: (−100) — history is the least urgent work in the system
+BACKFILL_PRIORITY = -200
+
+#: cursor-file schema (readers reject newer-than-known)
+BACKFILL_CURSOR_SCHEMA = 1
+
+
+class ChainBackfill:
+    """Backward window walker over the same JSON-RPC duck type the
+    follower uses (``eth_blockNumber`` / ``eth_getBlockByNumber`` /
+    ``eth_getTransactionReceipt`` / ``eth_getCode``)."""
+
+    def __init__(self, daemon, client, window: int = 64,
+                 poll: float = 2.0,
+                 cursor_path: Optional[str] = None,
+                 tenant: str = "backfill",
+                 priority: int = BACKFILL_PRIORITY,
+                 max_backoff: float = 60.0,
+                 idle_poll: float = 60.0,
+                 window_attempts: int = 5):
+        self.daemon = daemon
+        self.client = client
+        self.window = max(1, int(window))
+        self.poll = max(0.05, float(poll))
+        self.cursor_path = cursor_path or os.path.join(
+            daemon.data_dir, "backfill_cursor.json")
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.max_backoff = float(max_backoff)
+        self.idle_poll = float(idle_poll)
+        self.window_attempts = max(1, int(window_attempts))
+        self.lo: Optional[int] = None
+        self.hi: Optional[int] = None
+        self._load_cursor()
+        self.ingested = 0
+        self.rpc_errors = 0
+        self.windows = 0
+        self._attempts = 0
+        self._done_emitted = False
+        self._backoff = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._reg = obs_metrics.REGISTRY
+
+    # --- cursor durability ----------------------------------------------
+    def _load_cursor(self) -> None:
+        try:
+            with open(self.cursor_path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return
+        if (not isinstance(doc, dict)
+                or int(doc.get("schema", 0) or 0) > BACKFILL_CURSOR_SCHEMA
+                or not isinstance(doc.get("lo"), int)
+                or not isinstance(doc.get("hi"), int)):
+            return
+        self.lo, self.hi = doc["lo"], doc["hi"]
+
+    def _save_cursor(self, lo: Optional[int] = None,
+                     hi: Optional[int] = None) -> None:
+        """Persist the cursor. Callers pass the NEW position and only
+        assign ``self.lo``/``self.hi`` after this returns — a position
+        visible in ``status()`` (and thus ``/healthz``) is always
+        already durable, so "done" can never be observed ahead of the
+        on-disk cursor."""
+        durable_write(
+            self.cursor_path,
+            json.dumps({"schema": BACKFILL_CURSOR_SCHEMA,
+                        "lo": self.lo if lo is None else lo,
+                        "hi": self.hi if hi is None else hi,
+                        "t": round(time.time(), 3)}).encode(),
+            rotate=False)
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-backfill")
+        self._thread.start()
+        obs_trace.event("backfill_started", lo=self.lo, hi=self.hi,
+                        window=self.window, tenant=self.tenant,
+                        priority=self.priority)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def status(self) -> Dict:
+        remaining = self.lo if self.lo is not None else None
+        return {"lo": self.lo, "hi": self.hi,
+                "remaining_blocks": remaining,
+                "ingested": self.ingested,
+                "rpc_errors": self.rpc_errors,
+                "windows": self.windows,
+                "backoff_sec": round(self._backoff, 3),
+                "done": self.lo == 0}
+
+    # --- the loop -------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                delay = self._tick()
+                self._backoff = 0.0
+            except Exception as e:  # noqa: BLE001 — the loop may not die
+                self.rpc_errors += 1
+                self._reg.counter(
+                    "serve_backfill_rpc_errors_total",
+                    help="backfill scan/ingest failures (backed off "
+                         "with jitter, window retried)").inc()
+                self._backoff = min(self.max_backoff,
+                                    max(self.poll, self._backoff * 2))
+                # multiplicative jitter so N replicas desynchronize
+                delay = self._backoff * (0.5 + random.random())
+                obs_trace.event("backfill_rpc_error",
+                                detail=f"{type(e).__name__}: "
+                                       f"{str(e)[:200]}",
+                                backoff=round(delay, 3))
+                log.warning("backfill: %s: %s (backing off %.1fs)",
+                            type(e).__name__, str(e)[:200], delay)
+            self._stop.wait(delay)
+        obs_trace.event("backfill_stopped", lo=self.lo, hi=self.hi,
+                        ingested=self.ingested)
+
+    def _tick(self) -> float:
+        """Scan and commit ONE window of blocks ``[lo-window, lo-1]``.
+        Returns how long to sleep before the next tick (0 while blocks
+        remain). The cursor advances only after every contract in the
+        window is durably submitted-or-deduped."""
+        if self.hi is None:
+            # first ever start: anchor the walk at the current head —
+            # the follower owns everything after this block
+            hi = int(self.client.eth_blockNumber(), 16)
+            self._save_cursor(lo=hi + 1, hi=hi)
+            self.hi, self.lo = hi, hi + 1
+        if self.lo is None:  # torn cursor healed as fresh anchor
+            self._save_cursor(lo=self.hi + 1)
+            self.lo = self.hi + 1
+        self._remaining_gauge()
+        if self.lo <= 0:
+            if not self._done_emitted:
+                self._done_emitted = True
+                obs_trace.event("backfill_done", hi=self.hi,
+                                ingested=self.ingested)
+            return self.idle_poll
+        w_lo = max(0, self.lo - self.window)
+        w_hi = self.lo - 1
+        contracts = []
+        for n in range(w_hi, w_lo - 1, -1):
+            if self._stop.is_set():
+                return self.poll     # cursor unmoved: window re-scanned
+            contracts.extend(deployed_contracts(self.client, n))
+        if contracts and not self._commit_window(w_lo, w_hi, contracts):
+            return self.poll         # backpressure/incomplete: retry
+        self._save_cursor(lo=w_lo)  # durable BEFORE visible
+        self.lo = w_lo
+        self.windows += 1
+        self._attempts = 0
+        self._reg.counter(
+            "serve_backfill_windows_total",
+            help="backfill windows fully committed (cursor "
+                 "advanced)").inc()
+        self._remaining_gauge()
+        return 0.0 if self.lo > 0 else 0.0
+
+    def _commit_window(self, w_lo: int, w_hi: int, contracts) -> bool:
+        """Submit one window's contracts as ONE submission and wait for
+        every one of them to resolve. Returns whether the cursor may
+        advance. Shed/errored results mean the window was NOT fully
+        answered — retried up to ``window_attempts`` times (dedupe
+        makes each retry nearly free), then advanced anyway with a
+        ``backfill_window_incomplete`` event so one poisoned window
+        can't wedge the whole-chain walk."""
+        tid = obs_trace.new_trace_id()
+        try:
+            sub = self.daemon.queue.submit(
+                contracts, tenant=self.tenant, priority=self.priority,
+                trace_id=tid)
+        except (QueueFull, QuotaExceeded):
+            self._reg.counter(
+                "serve_backfill_backpressure_total",
+                help="backfill windows deferred by a full queue or "
+                     "spent quota").inc()
+            return False
+        except QueueClosed:
+            self._stop.set()
+            return False
+        while not self._stop.is_set() and not sub.wait_done(timeout=1.0):
+            pass
+        if self._stop.is_set() and not sub.wait_done(timeout=0.0):
+            return False             # shutdown mid-window: re-scan it
+        snap = sub.snapshot()
+        bad = [r for r in snap.get("results") or []
+               if r.get("status") not in ("ok", "quarantined")]
+        if bad:
+            self._attempts += 1
+            self._reg.counter(
+                "serve_backfill_window_retries_total",
+                help="backfill windows retried because some results "
+                     "came back shed/errored").inc()
+            if self._attempts < self.window_attempts:
+                return False
+            obs_trace.event("backfill_window_incomplete",
+                            lo=w_lo, hi=w_hi, bad=len(bad),
+                            attempts=self._attempts, trace_id=tid)
+            log.warning("backfill: window [%d, %d] advanced with %d "
+                        "unresolved result(s) after %d attempts",
+                        w_lo, w_hi, len(bad), self._attempts)
+        self.ingested += len(contracts)
+        self._reg.counter(
+            "serve_backfill_ingested_total",
+            help="historical contracts submitted-or-deduped by the "
+                 "backfill walker").inc(len(contracts))
+        obs_trace.event("backfill_window", lo=w_lo, hi=w_hi,
+                        n=len(contracts), trace_id=tid)
+        return True
+
+    def _remaining_gauge(self) -> None:
+        if self.lo is not None:
+            self._reg.gauge(
+                "serve_backfill_remaining_blocks",
+                help="blocks below the backfill cursor still to be "
+                     "walked").set(max(0, self.lo))
+
+
+__all__ = ["BACKFILL_CURSOR_SCHEMA", "BACKFILL_PRIORITY",
+           "ChainBackfill"]

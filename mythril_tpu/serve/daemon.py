@@ -38,7 +38,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from .http import ServeHTTPServer
 from .queue import AdmissionQueue, ShedPolicy, TenantQuota
-from .scheduler import Scheduler
+from .scheduler import Scheduler, StoreOnlyScheduler
 from .store import ResultsStore
 
 log = logging.getLogger(__name__)
@@ -134,7 +134,30 @@ class AnalysisDaemon:
                  default_quota: Optional[TenantQuota] = None,
                  shed: Optional[ShedPolicy] = "auto",
                  follow_uri: Optional[str] = None,
-                 follow_poll: float = 2.0):
+                 follow_poll: float = 2.0,
+                 backfill_uri: Optional[str] = None,
+                 backfill_window: int = 64,
+                 backfill_poll: float = 2.0,
+                 compact_every: Optional[float] = None,
+                 store_only: bool = False,
+                 store_refresh: float = 2.0):
+        if store_only:
+            # an edge replica has no engine: it cannot host a fleet,
+            # tail the chain, backfill history, or serve without the
+            # store it exists to serve from
+            bad = [n for n, v in (("--fleet", fleet_dir),
+                                  ("--follow", follow_uri),
+                                  ("--backfill", backfill_uri),
+                                  ("--compact-every", compact_every))
+                   if v]
+            if bad:
+                raise ValueError(
+                    f"--store-only is incompatible with "
+                    f"{', '.join(bad)}")
+            if not dedupe:
+                raise ValueError(
+                    "--store-only needs the dedupe store "
+                    "(--no-dedupe makes no sense here)")
         self.options = options or ServeOptions()
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
@@ -151,18 +174,33 @@ class AnalysisDaemon:
         # "auto" = <data-dir>/solver_store; None disables.
         if solver_store == "auto":
             solver_store = os.path.join(data_dir, "solver_store")
+        if store_only:
+            solver_store = None  # no solver work will ever run here
         self.solver_store = solver_store
+        self.store_only = bool(store_only)
         self.queue = AdmissionQueue(
             store=self.store, dedupe=dedupe, max_depth=max_queue,
             config_fn=self.options.effective, quotas=quotas,
-            default_quota=default_quota, shed=shed)
+            default_quota=default_quota, shed=shed,
+            store_only=store_only)
         self.follow_uri = follow_uri
         self.follow_poll = float(follow_poll)
         self.follower = None
-        self.scheduler = Scheduler(
-            self.queue, store=self.store,
-            batch_size=self.options.batch_size,
-            fleet_dir=fleet_dir, campaign_factory=campaign_factory)
+        self.backfill_uri = backfill_uri
+        self.backfill_window = int(backfill_window)
+        self.backfill_poll = float(backfill_poll)
+        self.backfill = None
+        self.compact_every = compact_every
+        self.store_refresh = max(0.05, float(store_refresh))
+        self._bg_stop = threading.Event()
+        self._bg_threads: List[threading.Thread] = []
+        if store_only:
+            self.scheduler = StoreOnlyScheduler()
+        else:
+            self.scheduler = Scheduler(
+                self.queue, store=self.store,
+                batch_size=self.options.batch_size,
+                fleet_dir=fleet_dir, campaign_factory=campaign_factory)
         self.host = host
         self._port = port
         self.drain_timeout = float(drain_timeout)
@@ -183,9 +221,15 @@ class AnalysisDaemon:
         return self.queue.submit(contracts, **kw)
 
     def health(self) -> Dict:
-        from ..smt import portfolio as smt_portfolio
+        if self.store_only:
+            # the smt package import chain reaches JAX — a store-only
+            # replica's healthz must stay backend-free (there is no
+            # solver store here anyway)
+            vstore = None
+        else:
+            from ..smt import portfolio as smt_portfolio
 
-        vstore = smt_portfolio.get_store()
+            vstore = smt_portfolio.get_store()
         qstats = self.queue.stats()
         doc = {
             "ok": True,
@@ -221,6 +265,11 @@ class AnalysisDaemon:
             doc["backend_tiers"] = tiers
         if self.follower is not None:
             doc["follower"] = self.follower.status()
+        if self.backfill is not None:
+            doc["backfill"] = self.backfill.status()
+        doc["store_generation"] = self.store.generation()
+        if self.store_only:
+            doc["store_only"] = True
         return doc
 
     @property
@@ -268,10 +317,58 @@ class AnalysisDaemon:
                 self, rpc_client_from_uri(self.follow_uri),
                 poll=self.follow_poll)
             self.follower.start()
+        if self.backfill_uri:
+            from ..utils.loader import rpc_client_from_uri
+            from .backfill import ChainBackfill
+
+            self.backfill = ChainBackfill(
+                self, rpc_client_from_uri(self.backfill_uri),
+                window=self.backfill_window, poll=self.backfill_poll)
+            self.backfill.start()
+        if self.compact_every and not self.store_only:
+            t = threading.Thread(target=self._compact_loop,
+                                 daemon=True, name="serve-compactor")
+            t.start()
+            self._bg_threads.append(t)
+        if self.store_only:
+            t = threading.Thread(target=self._refresh_loop,
+                                 daemon=True, name="serve-refresher")
+            t.start()
+            self._bg_threads.append(t)
         obs_trace.event("serve_started", host=self.host, port=self.port,
                         data_dir=self.data_dir)
         log.info("serving on %s:%d (data dir %s)", self.host, self.port,
                  self.data_dir)
+
+    def _compact_loop(self) -> None:
+        """Background compactor (``--compact-every``): periodically
+        fold settled loose verdicts into the segment tier. ONE replica
+        per data dir runs this (docs/serving.md deployment contract);
+        a failed pass is logged and retried next period — the loose
+        files it would have folded are still fully servable."""
+        while not self._bg_stop.wait(self.compact_every):
+            try:
+                stats = self.store.compact()
+                if stats.get("folded") or stats.get("dupes"):
+                    log.info("compacted store: %s", stats)
+            except Exception as e:  # noqa: BLE001 — keep the daemon up
+                obs_metrics.REGISTRY.counter(
+                    "serve_store_compaction_errors_total",
+                    help="background compaction passes that failed "
+                         "(retried next period)").inc()
+                log.warning("compaction failed: %s: %s",
+                            type(e).__name__, str(e)[:200])
+
+    def _refresh_loop(self) -> None:
+        """Store-only replica poll: pick up manifest generations
+        committed by the analysis fleet on the shared/snapshotted data
+        dir."""
+        while not self._bg_stop.wait(self.store_refresh):
+            try:
+                self.store.refresh()
+            except Exception as e:  # noqa: BLE001 — keep serving
+                log.warning("manifest refresh failed: %s: %s",
+                            type(e).__name__, str(e)[:200])
 
     def shutdown(self, reason: str = "shutdown") -> None:
         """Graceful drain; idempotent and safe from any thread (the
@@ -284,11 +381,17 @@ class AnalysisDaemon:
         obs_trace.event("serve_draining", reason=reason)
         log.info("draining (%s): rejecting new submissions, finishing "
                  "the in-flight batch", reason)
+        self._bg_stop.set()
         if self.follower is not None:
             # the follower stops BEFORE the queue closes, so its last
             # block either submitted fully or will be retried from the
             # durable cursor on restart — never half-ingested
             self.follower.stop()
+        if self.backfill is not None:
+            # same ordering argument: a window interrupted before its
+            # cursor advanced is simply re-scanned on restart, and the
+            # dedupe store makes the overlap free
+            self.backfill.stop()
         self.queue.close()
         self.scheduler.request_stop()
         if not self.scheduler.join(self.drain_timeout):

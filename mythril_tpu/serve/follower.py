@@ -59,6 +59,34 @@ FOLLOWER_PRIORITY = -100
 CURSOR_SCHEMA = 1
 
 
+def deployed_contracts(client, n: int) -> List[Tuple[str, bytes]]:
+    """``(address, runtime_bytecode)`` for every contract created in
+    block ``n`` — the deployment-scan shared by the head follower and
+    the backward backfill walker (``serve/backfill.py``). Creations
+    without a receipt/address or with empty runtime code
+    (selfdestructed in the same block, EOA funding) are skipped."""
+    blk = client.eth_getBlockByNumber(hex(n), True)
+    out: List[Tuple[str, bytes]] = []
+    for tx in (blk or {}).get("transactions") or []:
+        if not isinstance(tx, dict) or tx.get("to"):
+            continue
+        txh = tx.get("hash")
+        if not txh:
+            continue
+        rcpt = client.eth_getTransactionReceipt(txh) or {}
+        addr = rcpt.get("contractAddress")
+        if not addr:
+            continue
+        code = client.eth_getCode(addr)
+        try:
+            raw = bytes.fromhex(str(code).removeprefix("0x"))
+        except ValueError:
+            continue
+        if raw:
+            out.append((str(addr), raw))
+    return out
+
+
 class ChainFollower:
     """Background ingestion loop over the existing JSON-RPC client
     (``utils/loader.HttpRpcClient`` — anything with ``eth_blockNumber``
@@ -192,30 +220,7 @@ class ChainFollower:
                 max(0, self.head - self.cursor))
 
     def _new_contracts(self, n: int) -> List[Tuple[str, bytes]]:
-        """``(address, runtime_bytecode)`` for every contract created
-        in block ``n``. Creations without a receipt/address or with
-        empty runtime code (selfdestructed in the same block, EOA
-        funding) are skipped."""
-        blk = self.client.eth_getBlockByNumber(hex(n), True)
-        out: List[Tuple[str, bytes]] = []
-        for tx in (blk or {}).get("transactions") or []:
-            if not isinstance(tx, dict) or tx.get("to"):
-                continue
-            txh = tx.get("hash")
-            if not txh:
-                continue
-            rcpt = self.client.eth_getTransactionReceipt(txh) or {}
-            addr = rcpt.get("contractAddress")
-            if not addr:
-                continue
-            code = self.client.eth_getCode(addr)
-            try:
-                raw = bytes.fromhex(str(code).removeprefix("0x"))
-            except ValueError:
-                continue
-            if raw:
-                out.append((str(addr), raw))
-        return out
+        return deployed_contracts(self.client, n)
 
     def _ingest_block(self, n: int) -> bool:
         """Submit block ``n``'s new contracts. Returns False on
@@ -251,4 +256,5 @@ class ChainFollower:
         return True
 
 
-__all__ = ["CURSOR_SCHEMA", "ChainFollower", "FOLLOWER_PRIORITY"]
+__all__ = ["CURSOR_SCHEMA", "ChainFollower", "FOLLOWER_PRIORITY",
+           "deployed_contracts"]

@@ -39,7 +39,8 @@ from typing import Dict, Optional, Tuple
 
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
-from .queue import QueueClosed, QueueFull, QuotaExceeded
+from .queue import (QueueClosed, QueueFull, QuotaExceeded,
+                    UNKNOWN_RETRY_AFTER)
 
 #: cap on submission body size: serve is an analysis API, not an
 #: artifact store; 64 MiB covers thousands of max-size contracts
@@ -170,7 +171,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         snap = sub.snapshot()
         snap["queue_depth"] = self.daemon.queue.depth()
-        self._json(202, snap)
+        headers = {}
+        if any(r.get("status") == "unknown-contract"
+               for r in snap.get("results") or []):
+            # a store-only replica answered at least one miss: tell
+            # the client when the next manifest refresh is worth a
+            # retry (the verdict may be compacting its way here)
+            headers["Retry-After"] = str(UNKNOWN_RETRY_AFTER)
+        self._json(202, snap, headers)
 
     def do_GET(self) -> None:  # noqa: N802
         url = urllib.parse.urlparse(self.path)

@@ -67,6 +67,10 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from .store import ResultsStore, bytecode_hash, config_hash
 
+#: the Retry-After (seconds) a store-only replica attaches to a typed
+#: ``unknown-contract`` answer — one manifest-refresh poll away
+UNKNOWN_RETRY_AFTER = 5
+
 
 class QueueFull(Exception):
     """Admission would exceed ``max_depth`` — back off and retry."""
@@ -312,9 +316,15 @@ class AdmissionQueue:
                  config_fn: Optional[Callable[[Dict], Dict]] = None,
                  quotas: Optional[Dict[str, TenantQuota]] = None,
                  default_quota: Optional[TenantQuota] = None,
-                 shed: Optional[ShedPolicy] = None):
+                 shed: Optional[ShedPolicy] = None,
+                 store_only: bool = False):
         self.store = store
         self.dedupe = bool(dedupe) and store is not None
+        #: edge-replica mode: NO engine behind this queue — a store
+        #: miss resolves at admission as a typed ``unknown-contract``
+        #: answer instead of queuing (docs/serving.md "Verdict
+        #: segments & edge replicas")
+        self.store_only = bool(store_only) and store is not None
         self.max_depth = max(1, int(max_depth))
         #: merges per-request option overrides into the daemon's base
         #: analysis config — the dict that config_hash covers
@@ -568,6 +578,26 @@ class AdmissionQueue:
                                 e, self._verdict_result(e, doc),
                                 served_from="dedupe-store")
                             continue
+                        if self.store_only:
+                            # edge replica: no engine to queue for —
+                            # a miss is a typed answer, never a 500
+                            self._reg.counter(
+                                "serve_unknown_contract_total",
+                                help="store-only submissions whose "
+                                     "verdict is not in the store "
+                                     "snapshot yet").inc()
+                            self._resolve_locked(
+                                e, {"status": "unknown-contract",
+                                    "error": "no stored verdict for "
+                                             "this (bytecode, config) "
+                                             "on this read replica; "
+                                             "retry after the next "
+                                             "manifest refresh or "
+                                             "submit to an analysis "
+                                             "daemon",
+                                    "retry_after": UNKNOWN_RETRY_AFTER},
+                                served_from=None)
+                            continue
                         # in-flight attach covers clones WITHIN this
                         # submission too (the index is updated as
                         # entries are admitted below): a corpus of N
@@ -813,4 +843,4 @@ class AdmissionQueue:
 
 __all__ = ["AdmissionQueue", "Entry", "QueueClosed", "QueueFull",
            "QuotaExceeded", "SHAPE_KEYS", "ShedPolicy", "Submission",
-           "TenantQuota", "shape_key_of"]
+           "TenantQuota", "UNKNOWN_RETRY_AFTER", "shape_key_of"]

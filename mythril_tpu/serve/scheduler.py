@@ -447,4 +447,41 @@ class Scheduler:
         return out
 
 
-__all__ = ["Scheduler", "default_campaign_factory"]
+class StoreOnlyScheduler:
+    """The null scheduler behind ``serve --store-only`` (docs/serving.md
+    "Verdict segments & edge replicas"): an edge replica has NO engine
+    — every answer comes from the dedupe store at admission time, so
+    nothing ever reaches a scheduler. This stub keeps the daemon's
+    lifecycle and ``/healthz`` surfaces working without importing any
+    engine/JAX code (the light-imports contract the store-only mode is
+    built on)."""
+
+    batches_run = 0
+    crashed = None
+
+    def start(self) -> None:
+        pass
+
+    def request_stop(self) -> None:
+        pass
+
+    def abort(self) -> None:
+        pass
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return True
+
+    def pending_fleet_units(self) -> int:
+        return 0
+
+    def degraded_configs(self) -> List[Dict]:
+        return []
+
+    def worker_restarts(self) -> int:
+        return 0
+
+    def tier_status(self) -> List[Dict]:
+        return []
+
+
+__all__ = ["Scheduler", "StoreOnlyScheduler", "default_campaign_factory"]

@@ -1,0 +1,419 @@
+"""Compacted, content-addressed verdict segments under the results
+store (docs/serving.md "Verdict segments & edge replicas").
+
+The live store (``serve/store.py``) keeps one loose JSON file per
+``(bytecode_hash, config_hash)`` key — the proven first-wins
+multi-replica write contract. That is correct for N writers but wrong
+for millions-of-keys READ scale: every ``count()`` is an ``os.listdir``
+and every cold read is a dentry lookup in a directory with a million
+entries. This module is the read-scale half: a background compactor
+folds settled loose files into immutable SEGMENT files (sorted
+key→verdict records, per-record and whole-file sha256), and a
+generation-numbered ``MANIFEST.json`` — committed via the repo-wide
+checkpoint contract (``save_json_checkpoint``: tmp + fsync + rotate +
+rename, content sha over the state) — names the segment set that IS
+generation N.
+
+Crash-safety argument (the PR 2 checkpoint contract, applied to a
+multi-file structure):
+
+* A segment file is content-addressed (``seg-<sha256(payload)[:32]>``)
+  and created with ``exclusive_write`` — it either exists complete or
+  not at all, and a re-run of the same compaction writes the same
+  bytes to the same name (EEXIST == already durable, not a conflict).
+* The manifest is the ONLY commit point. Loose files are unlinked
+  strictly AFTER the new manifest generation is durable, so a SIGKILL
+  at any instant leaves every verdict readable from either its loose
+  file or the previous manifest generation. An orphan segment from a
+  crashed compaction is harmless (unreferenced, GC'd by the next
+  successful commit).
+* A torn/bit-rotted segment is DETECTED by checksum on read,
+  quarantined to ``*.corrupt`` with a counter tick, and dropped from
+  the in-memory index — its keys become misses that fall back to
+  re-analysis. Never a wrong answer.
+* A half-written manifest falls back to the rotated ``.1`` previous
+  generation (``load_json_checkpoint_resilient``); because manifests
+  only ever carry segments forward, generation N−1 references a subset
+  of the segments on disk — no key vanishes, the newest fold is simply
+  re-done from the still-present loose files.
+
+Readers (``SegmentStore``) hold a bounded in-memory key→segment index
+(one dict entry per key, no verdict bodies) plus a small LRU of parsed
+segments, and refresh by stat()ing the manifest — a ``--store-only``
+edge replica polls this to pick up generations committed by the
+analysis fleet.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..utils.checkpoint import (
+    CheckpointCorrupt, exclusive_write, load_json_checkpoint_resilient,
+    save_json_checkpoint)
+
+#: manifest state schema (inside the checkpoint wrapper)
+MANIFEST_SCHEMA = 1
+#: segment payload schema
+SEGMENT_SCHEMA = 1
+MANIFEST_NAME = "MANIFEST.json"
+SEGMENT_DIR = "segments"
+
+#: loose verdict files eligible for compaction: <bch>.<cfh>.json
+LOOSE_RE = re.compile(r"^[0-9a-f]{32}\.[0-9a-f]{16}\.json$")
+_SEG_RE = re.compile(r"^seg-([0-9a-f]{32})\.json$")
+
+#: test hook: SIGKILL-equivalent (``os._exit``) at a named point of the
+#: compaction protocol, driven by the chaos cells and the kill-mid-
+#: compaction tests. Points: after-segment (segment durable, manifest
+#: not), after-manifest (manifest durable, loose files not yet
+#: unlinked), before-unlink (same, from the store's fold loop).
+_KILL_ENV = "MYTHRIL_SEGSTORE_KILL"
+
+
+def _maybe_kill(point: str) -> None:
+    if os.environ.get(_KILL_ENV) == point:
+        os._exit(9)
+
+
+def record_sha(key: str, verdict: Dict) -> str:
+    """Per-record integrity hash: the key and the canonical verdict
+    JSON together, so a record can't be silently re-homed onto another
+    key inside an otherwise-valid segment."""
+    blob = key + "\n" + json.dumps(verdict, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _segment_payload(records: List[Dict]) -> bytes:
+    return json.dumps({"schema": SEGMENT_SCHEMA, "records": records},
+                      sort_keys=True).encode()
+
+
+class SegmentStore:
+    """Read/compact view over ``<store>/segments/`` + ``MANIFEST.json``.
+
+    Thread-safe (one RLock); safe to point at a read-only snapshot of
+    a data dir (``__init__`` creates nothing — only ``compact_commit``
+    makes directories). ``validate`` is the owning store's per-doc
+    check (schema / bytecode_hash / config_hash), injected so this
+    layer stays ignorant of the verdict schema."""
+
+    def __init__(self, path: str,
+                 validate: Optional[Callable[[str, Dict], bool]] = None,
+                 cache_segments: int = 4):
+        self.path = path
+        self.seg_dir = os.path.join(path, SEGMENT_DIR)
+        self.manifest_path = os.path.join(path, MANIFEST_NAME)
+        self.validate = validate
+        self.generation = 0
+        self._index: Dict[str, str] = {}      # key -> segment filename
+        self._segments: List[Dict] = []       # manifest descriptors
+        self._cache: "OrderedDict[str, Dict[str, Tuple[str, Dict]]]" = \
+            OrderedDict()                      # seg fn -> key -> (sha, doc)
+        self._cache_segments = max(1, int(cache_segments))
+        self._manifest_sig: Optional[Tuple[int, int]] = None
+        self._lock = threading.RLock()
+        self.refresh(force=True)
+
+    # -- manifest / index --------------------------------------------
+
+    def _stat_sig(self) -> Optional[Tuple[int, int]]:
+        try:
+            st = os.stat(self.manifest_path)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
+
+    def refresh(self, force: bool = False) -> bool:
+        """Re-read the manifest if it changed on disk (cheap stat
+        compare unless ``force``). Returns whether a new generation was
+        installed. A corrupt manifest NEVER drops the in-memory index:
+        the resilient loader falls back to the rotated previous
+        generation, and if both copies are torn we keep serving the
+        generation already loaded — keys fall back to loose files or
+        re-analysis, never to a 500."""
+        with self._lock:
+            sig = self._stat_sig()
+            if not force and sig == self._manifest_sig:
+                return False
+            try:
+                state, _src = load_json_checkpoint_resilient(
+                    self.manifest_path)
+            except CheckpointCorrupt:
+                obs_metrics.REGISTRY.counter(
+                    "serve_store_manifest_corrupt_total",
+                    help="manifest loads where every copy was torn "
+                         "(previous in-memory generation kept)").inc()
+                self._manifest_sig = sig
+                return False
+            self._manifest_sig = sig
+            if state is None:
+                return False
+            if (not isinstance(state, dict)
+                    or int(state.get("schema", 0)) > MANIFEST_SCHEMA):
+                return False
+            segments = []
+            index: Dict[str, str] = {}
+            for seg in state.get("segments") or []:
+                fn = seg.get("file", "")
+                if (not _SEG_RE.match(fn) or not os.path.exists(
+                        os.path.join(self.seg_dir, fn))):
+                    # quarantined/missing segment: its keys fall back
+                    # to loose files or re-analysis
+                    continue
+                segments.append(seg)
+                for k in seg.get("keys") or []:
+                    index[k] = fn
+            self._segments = segments
+            self._index = index
+            self.generation = int(state.get("generation", 0))
+            self._cache.clear()
+            reg = obs_metrics.REGISTRY
+            reg.gauge(
+                "serve_store_segment_keys",
+                help="verdict keys indexed by the newest manifest "
+                     "generation").set(len(index))
+            reg.gauge(
+                "serve_store_generation",
+                help="newest loaded manifest generation").set(
+                self.generation)
+            return True
+
+    # -- reads --------------------------------------------------------
+
+    def _quarantine(self, fn: str, why: str) -> None:
+        """One torn/invalid segment: move aside as ``.corrupt`` (never
+        served again, kept for forensics), tick the counter, drop its
+        keys from the index so they fall back to re-analysis."""
+        p = os.path.join(self.seg_dir, fn)
+        try:
+            os.replace(p, p + ".corrupt")
+        except OSError:
+            pass
+        obs_metrics.REGISTRY.counter(
+            "serve_store_segment_corrupt_total",
+            help="segments quarantined .corrupt on checksum/schema "
+                 "failure (their keys fall back to re-analysis)").inc()
+        obs_trace.event("segment_quarantined", file=fn, why=why)
+        with self._lock:
+            self._cache.pop(fn, None)
+            self._segments = [s for s in self._segments
+                              if s.get("file") != fn]
+            self._index = {k: v for k, v in self._index.items()
+                           if v != fn}
+
+    def _load_segment(self, fn: str) -> Optional[Dict[str, Tuple[str, Dict]]]:
+        with self._lock:
+            cached = self._cache.get(fn)
+            if cached is not None:
+                self._cache.move_to_end(fn)
+                return cached
+        p = os.path.join(self.seg_dir, fn)
+        try:
+            with open(p, "rb") as fh:
+                raw = fh.read()
+        except OSError:
+            self._quarantine(fn, "unreadable")
+            return None
+        m = _SEG_RE.match(fn)
+        if (m is None or
+                hashlib.sha256(raw).hexdigest()[:32] != m.group(1)):
+            self._quarantine(fn, "checksum")
+            return None
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            self._quarantine(fn, "json")
+            return None
+        if (not isinstance(payload, dict)
+                or int(payload.get("schema", 0)) > SEGMENT_SCHEMA):
+            self._quarantine(fn, "schema")
+            return None
+        parsed: Dict[str, Tuple[str, Dict]] = {}
+        for rec in payload.get("records") or []:
+            if not isinstance(rec, dict):
+                continue
+            parsed[str(rec.get("key"))] = (
+                str(rec.get("sha256")), rec.get("verdict"))
+        with self._lock:
+            self._cache[fn] = parsed
+            self._cache.move_to_end(fn)
+            while len(self._cache) > self._cache_segments:
+                self._cache.popitem(last=False)
+        return parsed
+
+    def get(self, bch: str, cfh: str) -> Optional[Dict]:
+        """The compacted verdict for one key, or None. Any integrity
+        failure — torn file, content-hash mismatch, per-record sha
+        mismatch, validator rejection — quarantines the segment and
+        returns None (a counted miss upstream)."""
+        key = f"{bch}.{cfh}"
+        with self._lock:
+            fn = self._index.get(key)
+        if fn is None:
+            return None
+        parsed = self._load_segment(fn)
+        if parsed is None:
+            return None
+        entry = parsed.get(key)
+        if entry is None:
+            self._quarantine(fn, "missing-key")
+            return None
+        sha, doc = entry
+        if (not isinstance(doc, dict) or sha != record_sha(key, doc)
+                or (self.validate is not None
+                    and not self.validate(key, doc))):
+            self._quarantine(fn, "record")
+            return None
+        return doc
+
+    def key_count(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._index)
+
+    def has(self, key: str) -> bool:
+        with self._lock:
+            return key in self._index
+
+    # -- compaction ---------------------------------------------------
+
+    def compact_commit(self, records: Dict[str, Dict]) -> Dict:
+        """Fold ``records`` (key → verdict doc) into one new immutable
+        segment and commit manifest generation N+1 that carries every
+        prior segment forward plus the new one. Returns stats. The
+        caller (``ResultsStore.compact``) unlinks the folded loose
+        files only AFTER this returns — the manifest commit is the
+        point of no return."""
+        with self._lock:
+            if not records:
+                return {"generation": self.generation, "folded": 0,
+                        "segments": len(self._segments)}
+            recs = [{"key": k, "sha256": record_sha(k, v), "verdict": v}
+                    for k, v in sorted(records.items())]
+            payload = _segment_payload(recs)
+            fn = f"seg-{hashlib.sha256(payload).hexdigest()[:32]}.json"
+            os.makedirs(self.seg_dir, exist_ok=True)
+            # content-addressed: EEXIST means the identical segment is
+            # already durable (a re-run after a crash), not a conflict
+            exclusive_write(os.path.join(self.seg_dir, fn), payload)
+            _maybe_kill("after-segment")
+            desc = {"file": fn,
+                    "sha256": hashlib.sha256(payload).hexdigest(),
+                    "count": len(recs),
+                    "keys": [r["key"] for r in recs]}
+            segments = [s for s in self._segments
+                        if s.get("file") != fn] + [desc]
+            state = {"schema": MANIFEST_SCHEMA,
+                     "generation": self.generation + 1,
+                     "t": round(time.time(), 3),
+                     "segments": segments}
+            save_json_checkpoint(self.manifest_path, state)
+            _maybe_kill("after-manifest")
+            # install in memory without a disk round-trip
+            self._segments = segments
+            for r in recs:
+                self._index[r["key"]] = fn
+            self.generation = state["generation"]
+            self._manifest_sig = self._stat_sig()
+            self._cache.clear()
+            self._gc_orphans()
+            reg = obs_metrics.REGISTRY
+            reg.counter(
+                "serve_store_compactions_total",
+                help="manifest generations committed by the "
+                     "compactor").inc()
+            reg.gauge("serve_store_segment_keys",
+                      help="verdict keys indexed by the newest "
+                           "manifest generation").set(len(self._index))
+            reg.gauge("serve_store_generation",
+                      help="newest loaded manifest generation").set(
+                self.generation)
+            obs_trace.event("store_compaction", generation=self.generation,
+                   folded=len(recs), segments=len(segments))
+            return {"generation": self.generation, "folded": len(recs),
+                    "segments": len(segments)}
+
+    def _gc_orphans(self) -> None:
+        """Remove segment files no manifest generation references —
+        leftovers of compactions that died between segment write and
+        manifest commit. Only called right after a successful commit,
+        so anything unreferenced by the NEW manifest is garbage (the
+        rotated previous manifest references a subset of it)."""
+        live = {s.get("file") for s in self._segments}
+        try:
+            names = os.listdir(self.seg_dir)
+        except OSError:
+            return
+        for fn in names:
+            if _SEG_RE.match(fn) and fn not in live:
+                try:
+                    os.unlink(os.path.join(self.seg_dir, fn))
+                except OSError:
+                    pass
+
+    # -- offline verification (tools/store_admin.py) ------------------
+
+    def verify(self) -> Dict:
+        """Read-only integrity sweep for the admin tool: load the
+        manifest WITHOUT installing it, checksum every referenced
+        segment (whole-file and per-record), and report — no
+        quarantining, no counters; safe on a live store."""
+        report: Dict = {"generation": 0, "segments": 0, "records": 0,
+                        "corrupt": []}
+        try:
+            state, _src = load_json_checkpoint_resilient(
+                self.manifest_path)
+        except CheckpointCorrupt:
+            report["corrupt"].append(
+                {"file": MANIFEST_NAME, "why": "all copies torn"})
+            return report
+        if not isinstance(state, dict):
+            return report
+        report["generation"] = int(state.get("generation", 0))
+        for seg in state.get("segments") or []:
+            fn = str(seg.get("file", ""))
+            p = os.path.join(self.seg_dir, fn)
+            m = _SEG_RE.match(fn)
+            try:
+                with open(p, "rb") as fh:
+                    raw = fh.read()
+            except OSError:
+                report["corrupt"].append({"file": fn, "why": "missing"})
+                continue
+            if (m is None
+                    or hashlib.sha256(raw).hexdigest()[:32] != m.group(1)
+                    or hashlib.sha256(raw).hexdigest()
+                    != seg.get("sha256")):
+                report["corrupt"].append({"file": fn, "why": "checksum"})
+                continue
+            try:
+                payload = json.loads(raw)
+            except ValueError:
+                report["corrupt"].append({"file": fn, "why": "json"})
+                continue
+            report["segments"] += 1
+            for rec in payload.get("records") or []:
+                key, doc = str(rec.get("key")), rec.get("verdict")
+                if rec.get("sha256") != record_sha(key, doc):
+                    report["corrupt"].append(
+                        {"file": fn, "key": key, "why": "record"})
+                else:
+                    report["records"] += 1
+        return report
+
+
+__all__ = ["MANIFEST_NAME", "MANIFEST_SCHEMA", "SEGMENT_DIR",
+           "SEGMENT_SCHEMA", "LOOSE_RE", "SegmentStore", "record_sha"]

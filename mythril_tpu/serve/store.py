@@ -23,6 +23,14 @@ Corrupt files are treated as counted misses, never errors, and are
 UNLINKED on read (mirroring ``smt/vstore.py``) so a first-wins
 re-commit can rewrite them instead of preserving the corruption
 forever.
+
+At backfill scale the loose-file layout stops being enough on the READ
+side, so the store is two-tier (docs/serving.md "Verdict segments &
+edge replicas"): reads check the loose file first (newest writes win),
+then the compacted segment index (``serve/segstore.py``).
+``compact()`` folds settled loose files into immutable segments behind
+a generation-numbered manifest and only THEN unlinks them — a SIGKILL
+anywhere leaves every key readable from one tier or the other.
 """
 
 from __future__ import annotations
@@ -35,9 +43,15 @@ from typing import Dict, Optional
 
 from ..obs import metrics as obs_metrics
 from ..utils.checkpoint import exclusive_write
+from .segstore import LOOSE_RE, SegmentStore, _maybe_kill
 
 #: verdict-file schema (readers reject newer-than-known)
 STORE_SCHEMA = 1
+
+#: how stale the cached loose-file tally in ``count()`` may be, in
+#: seconds — healthz hits between recounts serve the cached number
+#: instead of an O(dir) listdir
+COUNT_TTL = 5.0
 
 
 def bytecode_hash(code: bytes) -> str:
@@ -74,19 +88,40 @@ def config_hash(config: Dict) -> str:
 
 
 class ResultsStore:
-    """One directory of verdict files: ``<dir>/<bch>.<cfh>.json``.
+    """One directory of verdict files: ``<dir>/<bch>.<cfh>.json``, plus
+    the compacted ``segments/`` tier behind ``MANIFEST.json``.
 
     Many writers (N replica daemons' scheduler threads), many readers
     (HTTP threads, the queue's admission check), across processes and
     hosts; file-level atomicity via first-wins ``exclusive_write`` is
-    the whole concurrency story — no lock, no index file to corrupt."""
+    the whole concurrency story for the loose tier — no lock, no index
+    file to corrupt. The segment tier is written by AT MOST ONE
+    compactor (deployment contract, docs/serving.md) and read by
+    everyone."""
 
     def __init__(self, path: str):
         self.path = path
         os.makedirs(path, exist_ok=True)
+        self.segments = SegmentStore(path, validate=self._valid_key_doc)
+        self._loose_n = 0
+        self._loose_t = -1e18  # force a recount on first count()
 
     def _file(self, bch: str, cfh: str) -> str:
         return os.path.join(self.path, f"{bch}.{cfh}.json")
+
+    def _valid_doc(self, bch: str, cfh: str, doc) -> bool:
+        """One verdict doc is servable for the REQUESTED key: right
+        schema, right bytecode hash, and right config hash — a
+        misnamed or cross-linked file must not serve a verdict computed
+        under a different config."""
+        return (isinstance(doc, dict)
+                and int(doc.get("schema", 0)) <= STORE_SCHEMA
+                and doc.get("bytecode_hash") == bch
+                and doc.get("config_hash") == cfh)
+
+    def _valid_key_doc(self, key: str, doc) -> bool:
+        bch, _, cfh = key.partition(".")
+        return self._valid_doc(bch, cfh, doc)
 
     def _corrupt_miss(self, path: str) -> None:
         """Count and UNLINK one unreadable verdict file so re-analysis
@@ -102,24 +137,24 @@ class ResultsStore:
             pass
 
     def get(self, bch: str, cfh: str) -> Optional[Dict]:
-        """The stored verdict, or None on miss. A corrupt or
-        newer-schema file is a MISS with a counter tick (and the file
-        is removed for rewrite), never an exception on the admission
+        """The stored verdict, or None on miss. The loose file wins
+        over the segment tier (it can only be the SAME verdict or a
+        fresher first-wins commit). A corrupt or newer-schema or
+        wrong-key file is a MISS with a counter tick (and the file is
+        removed for rewrite), never an exception on the admission
         path."""
         p = self._file(bch, cfh)
         try:
             with open(p) as fh:
                 doc = json.load(fh)
         except FileNotFoundError:
-            return None
+            return self.segments.get(bch, cfh)
         except (OSError, ValueError):
             self._corrupt_miss(p)
-            return None
-        if (not isinstance(doc, dict)
-                or int(doc.get("schema", 0)) > STORE_SCHEMA
-                or doc.get("bytecode_hash") != bch):
+            return self.segments.get(bch, cfh)
+        if not self._valid_doc(bch, cfh, doc):
             self._corrupt_miss(p)
-            return None
+            return self.segments.get(bch, cfh)
         return doc
 
     def put(self, bch: str, cfh: str, verdict: Dict) -> bool:
@@ -135,12 +170,28 @@ class ResultsStore:
                "config_hash": cfh, "t": round(time.time(), 3)}
         doc.update(verdict)
         blob = json.dumps(doc, sort_keys=True).encode()
-        won = exclusive_write(self._file(bch, cfh), blob)
-        if not won and self.get(bch, cfh) is None:
-            # the incumbent was corrupt: get() unlinked it — retry
-            won = exclusive_write(self._file(bch, cfh), blob)
+        p = self._file(bch, cfh)
+        won = exclusive_write(p, blob)
+        if not won:
+            # probe the INCUMBENT loose file only (not the segment
+            # tier): if it is corrupt, heal it and retry the write
+            try:
+                with open(p) as fh:
+                    incumbent = json.load(fh)
+            except FileNotFoundError:
+                incumbent = None
+            except (OSError, ValueError):
+                self._corrupt_miss(p)
+                incumbent = None
+            else:
+                if not self._valid_doc(bch, cfh, incumbent):
+                    self._corrupt_miss(p)
+                    incumbent = None
+            if incumbent is None:
+                won = exclusive_write(p, blob)
         reg = obs_metrics.REGISTRY
         if won:
+            self._loose_n += 1
             reg.counter(
                 "serve_store_writes_total",
                 help="verdicts persisted to the results store").inc()
@@ -152,13 +203,86 @@ class ResultsStore:
         return won
 
     def count(self) -> int:
-        """Number of stored verdicts (healthz diagnostics; O(dir))."""
+        """Number of stored verdicts: the manifest's key count plus a
+        cached loose-file tally recounted at most every ``COUNT_TTL``
+        seconds — bounded staleness instead of an O(dir) listdir on
+        every healthz probe."""
+        now = time.monotonic()
+        if now - self._loose_t > COUNT_TTL:
+            try:
+                self._loose_n = sum(
+                    1 for f in os.listdir(self.path) if LOOSE_RE.match(f))
+            except OSError:
+                self._loose_n = 0
+            self._loose_t = now
+        return self.segments.key_count() + self._loose_n
+
+    def refresh(self) -> bool:
+        """Pick up a manifest generation committed by another process
+        (the edge-replica poll)."""
+        return self.segments.refresh()
+
+    def generation(self) -> int:
+        return self.segments.generation
+
+    def compact(self) -> Dict:
+        """Fold every settled loose verdict into the segment tier and
+        unlink the folded files. Crash-safe at any instant: loose
+        files are removed only AFTER the new manifest generation is
+        durable, and keys already compacted are unlinked without
+        rewriting (the overlap after a crash-resume is free). Corrupt
+        loose files are counted misses and unlinked, never folded.
+        Returns stats ``{generation, folded, dupes, corrupt,
+        segments}``."""
+        self.segments.refresh(force=True)
+        fresh: Dict[str, Dict] = {}
+        dupes = []
+        corrupt = 0
         try:
-            return sum(1 for f in os.listdir(self.path)
-                       if f.endswith(".json"))
+            names = sorted(os.listdir(self.path))
         except OSError:
-            return 0
+            names = []
+        for fn in names:
+            if not LOOSE_RE.match(fn):
+                continue
+            key = fn[:-len(".json")]
+            p = os.path.join(self.path, fn)
+            if self.segments.has(key):
+                dupes.append(p)
+                continue
+            try:
+                with open(p) as fh:
+                    doc = json.load(fh)
+            except FileNotFoundError:
+                continue
+            except (OSError, ValueError):
+                self._corrupt_miss(p)
+                corrupt += 1
+                continue
+            if not self._valid_key_doc(key, doc):
+                self._corrupt_miss(p)
+                corrupt += 1
+                continue
+            fresh[key] = doc
+        stats = self.segments.compact_commit(fresh)
+        _maybe_kill("before-unlink")
+        # manifest is durable: the loose copies are now redundant
+        for key in fresh:
+            try:
+                os.unlink(os.path.join(self.path, key + ".json"))
+            except OSError:
+                pass
+        for p in dupes:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        self._loose_t = -1e18  # invalidate the cached tally
+        stats = dict(stats)
+        stats["dupes"] = len(dupes)
+        stats["corrupt"] = corrupt
+        return stats
 
 
-__all__ = ["STORE_SCHEMA", "ResultsStore", "bytecode_hash",
+__all__ = ["STORE_SCHEMA", "COUNT_TTL", "ResultsStore", "bytecode_hash",
            "config_hash"]

@@ -1,0 +1,150 @@
+"""Whole-chain backfill (serve/backfill.py, ``serve --backfill URI``):
+backward window walk to genesis, durable two-ended cursor, kill/resume
+exactly-once (dedupe makes the at-most-one-window overlap free), and
+bounded backoff with jitter on RPC failure. Reuses the canned loopback
+JSON-RPC chain + stub engine from tests/test_follower.py.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.obs import metrics as obs_metrics
+from mythril_tpu.serve import (BACKFILL_PRIORITY, FOLLOWER_PRIORITY,
+                               AnalysisDaemon, ServeOptions)
+from test_follower import (StubCampaign, _ChainNode, _deploy, _wait,
+                           counter, node)  # noqa: F401
+
+CFH_DONT_CARE = None  # backfill uses the daemon's base config
+
+ADDRS = ["0x" + f"{i:02x}" * 20 for i in range(1, 9)]
+
+
+@pytest.fixture(autouse=True)
+def _restore_registry_enabled():
+    was = obs_metrics.REGISTRY.enabled
+    yield
+    obs_metrics.REGISTRY.enabled = was
+
+
+def _daemon(tmp_path, node_url, stub, **kw):
+    kw.setdefault("options", ServeOptions(batch_size=4))
+    kw.setdefault("solver_store", None)
+    kw.setdefault("backfill_window", 2)
+    dm = AnalysisDaemon(
+        data_dir=str(tmp_path / "serve_data"), port=0,
+        campaign_factory=(lambda cfg: stub),
+        backfill_uri=node_url, backfill_poll=0.05, **kw)
+    dm.backfill_poll = 0.05
+    dm.start()
+    dm.backfill.poll = 0.05
+    dm.backfill.idle_poll = 0.05
+    return dm
+
+
+def test_backfill_walks_history_to_genesis(tmp_path, node):
+    """The walker anchors hi at the head, walks backward in windows,
+    analyzes every historical deployment, and finishes at lo == 0 with
+    the cursor durable and the verdicts stored."""
+    _ChainNode.head = 5
+    _deploy(1, ADDRS[0], "0x01aa")         # distinct bytecodes so the
+    _deploy(3, ADDRS[1], "0x02bb")         # store gets distinct keys
+    _deploy(4, ADDRS[2], "0x03cc")
+    stub = StubCampaign()
+    dm = _daemon(tmp_path, node, stub)
+    try:
+        bf = dm.backfill
+        assert bf is not None and bf.priority == BACKFILL_PRIORITY
+        assert BACKFILL_PRIORITY < FOLLOWER_PRIORITY
+        assert _wait(lambda: bf.status()["done"]), bf.status()
+        st = bf.status()
+        assert st["lo"] == 0 and st["hi"] == 5
+        assert st["remaining_blocks"] == 0
+        assert st["ingested"] == 3
+        # all three historical contracts analyzed and stored
+        assert _wait(lambda: dm.store.count() == 3)
+        names = [n for b in stub.batches for n in b]
+        assert {n.split("@")[0].split("_")[0][:42] for n in names} \
+            >= {a for a in ADDRS[:3]}
+        # durable cursor on disk
+        cur = json.load(open(os.path.join(dm.data_dir,
+                                          "backfill_cursor.json")))
+        assert cur["lo"] == 0 and cur["hi"] == 5
+        # healthz carries the backfill block
+        health = dm.health()
+        assert health["backfill"]["done"] is True
+        assert health["tenants"]["backfill"]["admitted"] == 3
+    finally:
+        dm.scheduler.abort()
+        dm.shutdown("test teardown")
+
+
+def test_backfill_kill_resume_exactly_once(tmp_path, node):
+    """Stop the daemon mid-walk; the restarted walker resumes from the
+    durable cursor (re-scanning at most one window) and every contract
+    in the whole range ends up analyzed-or-deduped exactly once —
+    the store holds exactly one verdict per distinct bytecode and no
+    bytecode was ANALYZED twice."""
+    _ChainNode.head = 7
+    for i, a in enumerate(ADDRS[:6]):
+        _deploy(i + 1, a, f"0x0{(i % 3) + 1}{'ee' * 4}")
+    stub1 = StubCampaign()
+    dm1 = _daemon(tmp_path, node, stub1)
+    try:
+        bf1 = dm1.backfill
+        # let it commit at least one window, then kill mid-walk
+        assert _wait(lambda: bf1.windows >= 1 and bf1.lo < 8)
+    finally:
+        dm1.scheduler.abort()
+        dm1.shutdown("mid-walk kill")
+    lo_after_kill = json.load(open(os.path.join(
+        dm1.data_dir, "backfill_cursor.json")))["lo"]
+    assert 0 <= lo_after_kill < 8
+    analyzed_before = [n for b in stub1.batches for n in b]
+
+    stub2 = StubCampaign()
+    dm2 = _daemon(tmp_path, node, stub2)
+    try:
+        bf2 = dm2.backfill
+        assert bf2.hi == 7                       # anchored once, durable
+        assert bf2.lo == lo_after_kill           # resumed, not re-anchored
+        assert _wait(lambda: bf2.status()["done"]), bf2.status()
+        # exactly-once: one verdict per distinct bytecode (3), and the
+        # second run never re-analyzed a bytecode the first run
+        # committed (the overlap window resolves via dedupe)
+        assert _wait(lambda: dm2.store.count() == 3)
+        analyzed_after = [n for b in stub2.batches for n in b]
+        assert len(analyzed_before) + len(analyzed_after) <= 6
+        # merged ingest record covers every deployment in the range
+        assert bf1.ingested + bf2.ingested >= 6
+    finally:
+        dm2.scheduler.abort()
+        dm2.shutdown("test teardown")
+
+
+def test_backfill_rpc_failure_backoff_with_jitter_then_recovery(
+        tmp_path, node):
+    _ChainNode.head = 3
+    _deploy(1, ADDRS[0], "0x01aa")
+    _ChainNode.fail_all = True
+    stub = StubCampaign()
+    dm = _daemon(tmp_path, node, stub)
+    try:
+        bf = dm.backfill
+        errs0 = counter("serve_backfill_rpc_errors_total")
+        assert _wait(lambda: bf.rpc_errors >= 2)
+        assert counter("serve_backfill_rpc_errors_total") >= errs0 + 2
+        assert 0 < bf._backoff <= bf.max_backoff  # bounded
+        assert dm.health()["ok"] is True          # daemon unaffected
+        # cursor never moved while the node was down
+        assert bf.lo is None or bf.lo == (bf.hi or 0) + 1
+        _ChainNode.fail_all = False               # node recovers
+        assert _wait(lambda: bf.status()["done"]), bf.status()
+        assert bf.ingested == 1
+        assert _wait(lambda: dm.store.count() == 1)
+    finally:
+        dm.scheduler.abort()
+        dm.shutdown("test teardown")

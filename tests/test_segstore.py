@@ -1,0 +1,330 @@
+"""Segmented verdict store (serve/segstore.py + the two-tier
+ResultsStore): compaction folds loose verdict files into immutable
+checksummed segments behind a generation-numbered manifest, reads fall
+back loose → segments, SIGKILL at any protocol point loses nothing,
+torn segments quarantine instead of serving wrong answers, and the
+offline admin tool (tools/store_admin.py) can verify/compact/stat a
+store without a daemon.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.obs import metrics as obs_metrics
+from mythril_tpu.serve.segstore import (MANIFEST_NAME, SEGMENT_DIR,
+                                        SegmentStore)
+from mythril_tpu.serve.store import (COUNT_TTL, ResultsStore,
+                                     bytecode_hash)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFH = "b" * 16
+
+
+def counter(name):
+    return obs_metrics.REGISTRY.counter(name).value
+
+
+@pytest.fixture(autouse=True)
+def _restore_registry_enabled():
+    was = obs_metrics.REGISTRY.enabled
+    yield
+    obs_metrics.REGISTRY.enabled = was
+
+
+def _put_n(store, n, start=0):
+    """n distinct verdicts; returns their bch list."""
+    bchs = []
+    for i in range(start, start + n):
+        bch = bytecode_hash(bytes([i % 256, i // 256]))
+        assert store.put(bch, CFH, {"status": "ok", "issues": []})
+        bchs.append(bch)
+    return bchs
+
+
+def _loose_files(path):
+    return sorted(f for f in os.listdir(path)
+                  if f.endswith(".json") and f != MANIFEST_NAME)
+
+
+# --- satellite: config_hash validated on read ------------------------
+
+def test_get_rejects_wrong_config_hash(tmp_path):
+    """A misnamed/cross-linked file must not serve a verdict computed
+    under a different config: the doc's config_hash is checked against
+    the REQUESTED cfh, the mismatch is a counted corrupt-miss and the
+    file is unlinked for rewrite."""
+    store = ResultsStore(str(tmp_path))
+    bch = bytecode_hash(b"\x01")
+    store.put(bch, CFH, {"status": "ok", "issues": []})
+    # cross-link: copy the verdict file under ANOTHER config's name
+    other = "c" * 16
+    src = os.path.join(str(tmp_path), f"{bch}.{CFH}.json")
+    dst = os.path.join(str(tmp_path), f"{bch}.{other}.json")
+    with open(src) as fh:
+        blob = fh.read()
+    with open(dst, "w") as fh:
+        fh.write(blob)
+    before = counter("serve_store_corrupt_total")
+    assert store.get(bch, other) is None
+    assert counter("serve_store_corrupt_total") == before + 1
+    assert not os.path.exists(dst)          # unlinked for rewrite
+    assert store.get(bch, CFH) is not None  # the real key unaffected
+
+
+# --- compaction fold + two-tier reads --------------------------------
+
+def test_compact_folds_loose_into_segments(tmp_path):
+    store = ResultsStore(str(tmp_path))
+    bchs = _put_n(store, 5)
+    stats = store.compact()
+    assert stats["folded"] == 5 and stats["generation"] == 1
+    # loose files gone, one segment + manifest remain
+    assert _loose_files(str(tmp_path)) == []
+    assert len(os.listdir(os.path.join(str(tmp_path),
+                                       SEGMENT_DIR))) == 1
+    # every verdict still readable (now via the segment index), also
+    # from a FRESH store instance (cold open of the manifest)
+    for st in (store, ResultsStore(str(tmp_path))):
+        for bch in bchs:
+            doc = st.get(bch, CFH)
+            assert doc is not None and doc["status"] == "ok"
+        assert st.count() == 5
+    # a second compact with nothing new is a no-op on the generation
+    stats2 = store.compact()
+    assert stats2["folded"] == 0
+    assert store.generation() == 1
+
+
+def test_put_after_compact_serves_loose_then_folds_as_dupe_free(
+        tmp_path):
+    store = ResultsStore(str(tmp_path))
+    _put_n(store, 2)
+    store.compact()
+    # new write after compaction lands loose and serves immediately
+    bch = bytecode_hash(b"fresh")
+    store.put(bch, CFH, {"status": "ok", "issues": [],
+                         "marker": "fresh"})
+    assert store.get(bch, CFH)["marker"] == "fresh"
+    assert store.count() == 3
+    stats = store.compact()
+    assert stats["folded"] == 1 and stats["generation"] == 2
+    assert store.get(bch, CFH)["marker"] == "fresh"
+    assert store.count() == 3
+
+
+def test_torn_segment_quarantined_keys_reanalyzable(tmp_path):
+    store = ResultsStore(str(tmp_path))
+    bchs = _put_n(store, 3)
+    store.compact()
+    seg_dir = os.path.join(str(tmp_path), SEGMENT_DIR)
+    (seg_fn,) = os.listdir(seg_dir)
+    # tear the segment mid-file (torn replica copy / bit rot)
+    p = os.path.join(seg_dir, seg_fn)
+    with open(p, "r+") as fh:
+        fh.truncate(os.path.getsize(p) // 2)
+    before = counter("serve_store_segment_corrupt_total")
+    assert store.get(bchs[0], CFH) is None       # miss, not wrong data
+    assert counter("serve_store_segment_corrupt_total") == before + 1
+    assert os.path.exists(p + ".corrupt")        # quarantined
+    assert not os.path.exists(p)
+    # every key of the torn segment is now a plain miss -> re-analysis
+    for bch in bchs:
+        assert store.get(bch, CFH) is None
+    # ...and a re-put heals the key through the loose tier
+    store.put(bchs[0], CFH, {"status": "ok", "issues": []})
+    assert store.get(bchs[0], CFH) is not None
+
+
+# --- crash safety: SIGKILL at every protocol point -------------------
+
+def _run_kill_compact(tmp_path, kill_point):
+    """Run one compaction in a subprocess that os._exit(9)s at
+    ``kill_point``; returns the subprocess result."""
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {str(ROOT)!r})
+        from mythril_tpu.serve.store import ResultsStore
+        ResultsStore({str(tmp_path)!r}).compact()
+        print("COMPLETED")
+    """)
+    env = dict(os.environ, MYTHRIL_SEGSTORE_KILL=kill_point,
+               JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=120)
+
+
+@pytest.mark.parametrize("kill_point", ["after-segment",
+                                        "after-manifest",
+                                        "before-unlink"])
+def test_kill_mid_compaction_loses_nothing(tmp_path, kill_point):
+    """SIGKILL-equivalent at each point of the compaction protocol:
+    every previously-stored verdict stays readable from SOME tier on
+    restart, and a re-run compaction converges to a clean store."""
+    store = ResultsStore(str(tmp_path))
+    bchs = _put_n(store, 4)
+    res = _run_kill_compact(tmp_path, kill_point)
+    assert res.returncode == 9, res.stderr        # really died mid-way
+    assert "COMPLETED" not in res.stdout
+    # restart: every verdict readable from loose file or manifest
+    st2 = ResultsStore(str(tmp_path))
+    for bch in bchs:
+        doc = st2.get(bch, CFH)
+        assert doc is not None and doc["status"] == "ok", (
+            f"{kill_point}: verdict lost")
+    # the re-run compaction converges: all keys in segments, loose
+    # gone, and the content-addressed segment write is idempotent
+    st2.compact()
+    assert _loose_files(str(tmp_path)) == []
+    st3 = ResultsStore(str(tmp_path))
+    for bch in bchs:
+        assert st3.get(bch, CFH) is not None
+    assert st3.count() == 4
+    # no orphan segments survive the converged commit
+    live = {s["file"] for s in st3.segments._segments}
+    on_disk = {f for f in os.listdir(os.path.join(str(tmp_path),
+                                                  SEGMENT_DIR))
+               if f.endswith(".json")}
+    assert on_disk == live
+
+
+# --- manifest generations (satellite) --------------------------------
+
+def test_reader_on_generation_n_serves_while_writer_commits_n1(
+        tmp_path):
+    writer = ResultsStore(str(tmp_path))
+    first = _put_n(writer, 3)
+    writer.compact()                              # generation 1
+    reader = ResultsStore(str(tmp_path))          # loads generation 1
+    assert reader.generation() == 1
+    # writer commits generation 2 while the reader holds 1
+    second = _put_n(writer, 2, start=100)
+    writer.compact()
+    assert writer.generation() == 2
+    # the un-refreshed reader keeps serving generation 1 correctly
+    assert reader.generation() == 1
+    for bch in first:
+        assert reader.get(bch, CFH) is not None
+    # the refresh poll picks up generation 2 — no restart needed
+    assert reader.refresh() is True
+    assert reader.generation() == 2
+    for bch in first + second:
+        assert reader.get(bch, CFH) is not None
+    assert reader.count() == 5
+
+
+def test_half_written_manifest_falls_back_to_previous_generation(
+        tmp_path):
+    """A reader that finds a torn newest manifest falls back to the
+    rotated generation N (no exception, no window where generation-N
+    keys vanish); keys folded only in the torn N+1 degrade to misses —
+    re-analysis, never a wrong answer."""
+    store = ResultsStore(str(tmp_path))
+    first = _put_n(store, 3)
+    store.compact()                               # generation 1
+    second = _put_n(store, 2, start=100)
+    store.compact()                               # generation 2
+    mp = os.path.join(str(tmp_path), MANIFEST_NAME)
+    with open(mp, "r+") as fh:                    # tear generation 2
+        fh.truncate(os.path.getsize(mp) // 2)
+    fresh = ResultsStore(str(tmp_path))
+    assert fresh.generation() == 1                # the .1 fallback
+    for bch in first:
+        assert fresh.get(bch, CFH) is not None    # gen-1 keys intact
+    for bch in second:
+        assert fresh.get(bch, CFH) is None        # miss, not a crash
+
+
+# --- count() bounded staleness (satellite) ---------------------------
+
+def test_count_is_cached_with_bounded_staleness(tmp_path):
+    store = ResultsStore(str(tmp_path))
+    _put_n(store, 2)
+    assert store.count() == 2
+    # a file another process dropped in is NOT seen inside the TTL...
+    bch = bytecode_hash(b"ext")
+    with open(os.path.join(str(tmp_path), f"{bch}.{CFH}.json"),
+              "w") as fh:
+        json.dump({"schema": 1, "bytecode_hash": bch,
+                   "config_hash": CFH, "status": "ok",
+                   "issues": []}, fh)
+    assert store.count() == 2
+    # ...but a forced TTL lapse recounts (bounded staleness, not
+    # forever-stale)
+    store._loose_t -= COUNT_TTL + 1
+    assert store.count() == 3
+    # our own put()s update the cached tally immediately
+    _put_n(store, 1, start=50)
+    assert store.count() == 4
+
+
+def test_segment_lru_bounded(tmp_path):
+    """The parsed-segment cache is bounded: N generations never pin N
+    parsed segment bodies in memory."""
+    seg = SegmentStore(str(tmp_path), cache_segments=2)
+    for gen in range(4):
+        seg.compact_commit(
+            {f"{bytecode_hash(bytes([gen]))}.{CFH}":
+             {"status": "ok", "issues": []}})
+    for gen in range(4):
+        assert seg.get(bytecode_hash(bytes([gen])), CFH) is not None
+    assert len(seg._cache) <= 2
+
+
+# --- tools/store_admin.py (satellite) --------------------------------
+
+def _load_store_admin():
+    spec = importlib.util.spec_from_file_location(
+        "store_admin", os.path.join(ROOT, "tools", "store_admin.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_store_admin_verify_compact_stats(tmp_path):
+    sa = _load_store_admin()
+    store_dir = str(tmp_path)
+    store = ResultsStore(store_dir)
+    _put_n(store, 3)
+    # same bytecode under a second config: dedupe ratio > 1
+    store.put(bytecode_hash(bytes([0, 0])), "d" * 16,
+              {"status": "ok", "issues": []})
+
+    st = sa.cmd_stats(store_dir)
+    assert st["loose_keys"] == 4 and st["segment_keys"] == 0
+    assert st["distinct_bytecodes"] == 3
+    assert st["bytecode_dedupe_ratio"] == pytest.approx(4 / 3, 0.01)
+
+    out = sa.cmd_compact(store_dir)
+    assert out["folded"] == 4 and out["generation"] == 1
+
+    rep = sa.cmd_verify(store_dir)
+    assert rep["ok"] is True
+    assert rep["records"] == 4 and rep["segments"] == 1
+
+    # verify reports (and does NOT quarantine) a torn segment
+    seg_dir = os.path.join(store_dir, SEGMENT_DIR)
+    (seg_fn,) = os.listdir(seg_dir)
+    p = os.path.join(seg_dir, seg_fn)
+    with open(p, "r+") as fh:
+        fh.truncate(os.path.getsize(p) // 2)
+    rep2 = sa.cmd_verify(store_dir)
+    assert rep2["ok"] is False
+    assert any(c["why"] == "checksum" for c in rep2["corrupt"])
+    assert os.path.exists(p)                      # read-only sweep
+
+    # the CLI entrypoint works end to end and exits nonzero on corrupt
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "store_admin.py"),
+         "verify", "--store", store_dir],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert res.returncode == 1
+    assert json.loads(res.stdout)["ok"] is False

@@ -1,0 +1,275 @@
+"""Edge replica mode (``serve --store-only``, docs/serving.md "Verdict
+segments & edge replicas"): an engine-free daemon serving dedupe-store
+answers from a manifest snapshot — store hits come back
+``served_from=dedupe-store``, misses are a typed ``unknown-contract``
+answer with a Retry-After header (never a 500), new manifest
+generations are picked up on the refresh poll, and the hot path stays
+free of engine/JAX backend initialization (the light-imports
+invariant). Plus the serve_client 429 Retry-After satellite.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import mythril_tpu  # noqa: F401
+from mythril_tpu.obs import metrics as obs_metrics
+from mythril_tpu.serve import AnalysisDaemon, ResultsStore
+from mythril_tpu.serve.queue import UNKNOWN_RETRY_AFTER
+from mythril_tpu.serve.store import bytecode_hash, config_hash
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+import serve_client  # noqa: E402
+
+KNOWN = b"\x60\x01\x60\x00\x55"
+UNKNOWN = b"\x60\x02\x60\x00\x55"
+
+
+@pytest.fixture(autouse=True)
+def _restore_registry_enabled():
+    was = obs_metrics.REGISTRY.enabled
+    yield
+    obs_metrics.REGISTRY.enabled = was
+
+
+def _seed_store(data_dir, codes, compact=True):
+    """Pre-populate a data dir the way an analysis fleet would: put
+    verdicts under the daemon's effective config hash, optionally
+    compact them into a manifest snapshot."""
+    dm = AnalysisDaemon(data_dir=data_dir, port=0, store_only=True,
+                        solver_store=None)
+    cfh = config_hash(dm.queue.config_fn({}))
+    store = ResultsStore(os.path.join(data_dir, "store"))
+    for code in codes:
+        store.put(bytecode_hash(code), cfh,
+                  {"status": "ok", "issues": []})
+    if compact:
+        store.compact()
+    return cfh
+
+
+def _start_replica(tmp_path, **kw):
+    kw.setdefault("solver_store", None)
+    dm = AnalysisDaemon(data_dir=str(tmp_path / "serve_data"), port=0,
+                        store_only=True, store_refresh=0.05, **kw)
+    dm.start()
+    return dm
+
+
+def test_store_only_serves_hits_and_types_misses(tmp_path):
+    data_dir = str(tmp_path / "serve_data")
+    _seed_store(data_dir, [KNOWN])
+    dm = _start_replica(tmp_path)
+    try:
+        url = f"http://127.0.0.1:{dm.port}/v1/submit"
+        req = urllib.request.Request(
+            url, data=json.dumps({
+                "contracts": [{"name": "hit", "code": KNOWN.hex()},
+                              {"name": "miss", "code": UNKNOWN.hex()}],
+                "tenant": "edge"}).encode(),
+            headers={"Content-Type": "application/json"})
+        before = obs_metrics.REGISTRY.counter(
+            "serve_unknown_contract_total").value
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.status == 202               # typed, never 500
+            assert resp.headers["Retry-After"] == str(
+                UNKNOWN_RETRY_AFTER)
+            snap = json.load(resp)
+        assert snap["state"] == "done"              # resolved at admission
+        by_name = {r["name"]: r for r in snap["results"]}
+        assert by_name["hit"]["status"] == "ok"
+        assert by_name["hit"]["served_from"] == "dedupe-store"
+        assert by_name["miss"]["status"] == "unknown-contract"
+        assert by_name["miss"]["retry_after"] == UNKNOWN_RETRY_AFTER
+        assert "error" in by_name["miss"]
+        assert obs_metrics.REGISTRY.counter(
+            "serve_unknown_contract_total").value == before + 1
+        # healthz declares the mode and the loaded generation
+        health = serve_client.healthz(f"http://127.0.0.1:{dm.port}")
+        assert health["store_only"] is True
+        assert health["store_generation"] == 1
+        assert health["ok"] is True
+    finally:
+        dm.shutdown("test teardown")
+
+
+def test_store_only_all_hit_submission_has_no_retry_after(tmp_path):
+    data_dir = str(tmp_path / "serve_data")
+    _seed_store(data_dir, [KNOWN])
+    dm = _start_replica(tmp_path)
+    try:
+        snap = serve_client.submit(
+            f"http://127.0.0.1:{dm.port}", [("hit", KNOWN)])
+        assert snap["results"][0]["served_from"] == "dedupe-store"
+    finally:
+        dm.shutdown("test teardown")
+
+
+def test_store_only_refresh_picks_up_new_generation(tmp_path):
+    """A generation the analysis fleet commits AFTER the replica
+    started is served without a restart — the manifest refresh poll
+    is the edge replica's whole update mechanism."""
+    data_dir = str(tmp_path / "serve_data")
+    cfh = _seed_store(data_dir, [KNOWN])
+    dm = _start_replica(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{dm.port}"
+        snap = serve_client.submit(base, [("m", UNKNOWN)])
+        assert snap["results"][0]["status"] == "unknown-contract"
+        # the "fleet" commits generation 2 with the missing verdict
+        writer = ResultsStore(os.path.join(data_dir, "store"))
+        writer.put(bytecode_hash(UNKNOWN), cfh,
+                   {"status": "ok", "issues": []})
+        writer.compact()
+        deadline = time.monotonic() + 10.0
+        served = None
+        while time.monotonic() < deadline:
+            snap = serve_client.submit(base, [("m", UNKNOWN)])
+            served = snap["results"][0]
+            if served["status"] == "ok":
+                break
+            time.sleep(0.05)
+        assert served["status"] == "ok", served
+        assert served["served_from"] == "dedupe-store"
+        assert dm.store.generation() == 2
+    finally:
+        dm.shutdown("test teardown")
+
+
+def test_store_only_rejects_engine_shaped_flags(tmp_path):
+    with pytest.raises(ValueError, match="store-only"):
+        AnalysisDaemon(data_dir=str(tmp_path / "d1"), store_only=True,
+                       fleet_dir=str(tmp_path / "fleet"))
+    with pytest.raises(ValueError, match="store-only"):
+        AnalysisDaemon(data_dir=str(tmp_path / "d2"), store_only=True,
+                       follow_uri="http://127.0.0.1:1")
+    with pytest.raises(ValueError, match="store-only"):
+        AnalysisDaemon(data_dir=str(tmp_path / "d3"), store_only=True,
+                       backfill_uri="http://127.0.0.1:1")
+    with pytest.raises(ValueError, match="dedupe"):
+        AnalysisDaemon(data_dir=str(tmp_path / "d4"), store_only=True,
+                       dedupe=False)
+
+
+def test_store_only_hot_path_is_backend_free(tmp_path):
+    """The whole store-only serving path — daemon up, store hit, store
+    miss, healthz, shutdown — never initializes a JAX backend (the
+    tests/test_light_imports.py invariant, applied to a live
+    daemon)."""
+    probe = f"""
+import sys, json, os, urllib.request
+sys.path.insert(0, {ROOT!r})
+from mythril_tpu.serve import AnalysisDaemon, ResultsStore, ServeOptions
+from mythril_tpu.serve.store import bytecode_hash, config_hash
+data_dir = {str(tmp_path / "probe_data")!r}
+cfh = config_hash(ServeOptions().effective({{}}))
+store = ResultsStore(os.path.join(data_dir, "store"))
+store.put(bytecode_hash({KNOWN!r}), cfh,
+          dict(status="ok", issues=[]))
+store.compact()
+dm = AnalysisDaemon(data_dir=data_dir, port=0, store_only=True,
+                    solver_store=None)
+dm.start()
+url = "http://127.0.0.1:%d/v1/submit" % dm.port
+req = urllib.request.Request(
+    url, data=json.dumps({{"contracts": [
+        {{"name": "hit", "code": {KNOWN.hex()!r}}},
+        {{"name": "miss", "code": {UNKNOWN.hex()!r}}}]}}).encode(),
+    headers={{"Content-Type": "application/json"}})
+snap = json.load(urllib.request.urlopen(req, timeout=30))
+assert snap["state"] == "done"
+by = {{r["name"]: r["status"] for r in snap["results"]}}
+assert by == {{"hit": "ok", "miss": "unknown-contract"}}, by
+json.load(urllib.request.urlopen(
+    "http://127.0.0.1:%d/healthz" % dm.port, timeout=30))
+dm.shutdown("probe done")
+from jax._src import xla_bridge
+assert not xla_bridge._backends, (
+    "store-only hot path initialized a backend: %r"
+    % (xla_bridge._backends,))
+print("CLEAN")
+"""
+    env = {k: v for k, v in os.environ.items()
+           if k != "JAX_PLATFORMS"}
+    r = subprocess.run([sys.executable, "-c", probe],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0 and "CLEAN" in r.stdout, (
+        f"store-only path touched a backend:\n{r.stdout}\n"
+        f"{r.stderr[-2000:]}")
+
+
+# --- serve_client 429 Retry-After (satellite) ------------------------
+
+def _http_error(code, headers):
+    import email.message
+
+    msg = email.message.Message()
+    for k, v in headers.items():
+        msg[k] = v
+    return urllib.error.HTTPError("http://x/", code, "err", msg,
+                                  io.BytesIO(b"{}"))
+
+
+def test_with_retry_honors_retry_after_on_429(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(serve_client.time, "sleep", sleeps.append)
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _http_error(429, {"Retry-After": "2.5"})
+        return {"ok": True}
+
+    assert serve_client.with_retry(fn, retries=3) == {"ok": True}
+    assert sleeps == [2.5]                    # the server's number
+
+    # the cap still applies to an absurd server value
+    sleeps.clear()
+    calls["n"] = 0
+
+    def fn2():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _http_error(429, {"Retry-After": "9999"})
+        return {"ok": True}
+
+    assert serve_client.with_retry(fn2, retries=3) == {"ok": True}
+    assert sleeps == [serve_client.MAX_BACKOFF_S]
+
+    # a 429 WITHOUT the header falls back to exponential backoff
+    sleeps.clear()
+    calls["n"] = 0
+
+    def fn3():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _http_error(429, {})
+        return {"ok": True}
+
+    assert serve_client.with_retry(fn3, retries=3) == {"ok": True}
+    assert len(sleeps) == 1 and 0 < sleeps[0] <= serve_client.MAX_BACKOFF_S
+
+
+def test_with_retry_429_exhausted_raises(monkeypatch):
+    monkeypatch.setattr(serve_client.time, "sleep", lambda s: None)
+
+    def fn():
+        raise _http_error(429, {"Retry-After": "1"})
+
+    with pytest.raises(urllib.error.HTTPError):
+        serve_client.with_retry(fn, retries=2)
+
+    # retries=0 keeps the old raise-through behavior
+    with pytest.raises(urllib.error.HTTPError):
+        serve_client.with_retry(fn, retries=0)

@@ -43,6 +43,21 @@ Injection points (columns):
   torn-store-verdict   truncate a committed verdict file in the shared
                        store mid-byte; the next replica must count it
                        a corrupt miss, re-analyze, and REWRITE it
+  kill-mid-compaction  os._exit(9) the compactor at each of the three
+                       protocol points (segment durable / manifest
+                       durable / before loose unlink); after every
+                       kill the store must verify clean and a re-run
+                       must converge (docs/serving.md "Verdict
+                       segments & edge replicas")
+  torn-segment         truncate a committed SEGMENT file mid-byte; the
+                       next replica must quarantine it ``.corrupt``,
+                       re-analyze its keys, and a re-compaction must
+                       heal the store to a clean new generation
+  kill-mid-backfill-window  SIGKILL a ``serve --backfill`` daemon
+                       mid-walk; the restarted walker must resume from
+                       the durable two-ended cursor (re-ingesting
+                       nothing already committed) and converge on one
+                       stored verdict per historical contract
 
 Modes (rows): ``batch`` (serial campaign), ``pipelined`` (depth-1
 pipeline), ``fleet`` (work-ledger campaign), ``serve`` (in-process
@@ -96,6 +111,8 @@ MATRIX: Dict[str, Tuple[str, ...]] = {
     "replica": ("kill-replica-mid-batch", "torn-store-verdict"),
     "tier": ("demote-mid-campaign", "repromote-mid-campaign",
              "tier-flap"),
+    "store": ("kill-mid-compaction", "torn-segment",
+              "kill-mid-backfill-window"),
 }
 
 N = 6  # distinct bytecodes (serve dedupe would collapse clones)
@@ -430,7 +447,8 @@ def _cell_serve(point: str, d: str, contracts,
 
 
 def _start_replica(d: str, tag: str, data_dir: str,
-                   fault: Optional[str] = None):
+                   fault: Optional[str] = None,
+                   extra: Optional[List[str]] = None):
     """One REAL serve daemon subprocess on the shared data dir;
     returns ``(proc, base_url)`` once it is listening."""
     import subprocess
@@ -444,6 +462,8 @@ def _start_replica(d: str, tag: str, data_dir: str,
            "--drain-timeout", "2"]
     if fault:
         cmd += ["--fault-inject", fault]
+    if extra:
+        cmd += extra
     proc = subprocess.Popen(cmd, cwd=ROOT,
                             env=dict(os.environ, JAX_PLATFORMS="cpu"),
                             stderr=subprocess.DEVNULL)
@@ -577,6 +597,301 @@ def _cell_replica_torn_store(d: str, contracts,
     return cell
 
 
+def _store_admin(cmd: str, store_dir: str,
+                 kill: Optional[str] = None) -> Tuple[int, Optional[Dict]]:
+    """Run ``tools/store_admin.py CMD --store DIR`` as a subprocess,
+    optionally with a MYTHRIL_SEGSTORE_KILL point armed; returns
+    ``(returncode, parsed_json_or_None)``."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MYTHRIL_SEGSTORE_KILL", None)
+    if kill:
+        env["MYTHRIL_SEGSTORE_KILL"] = kill
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "store_admin.py"),
+         cmd, "--store", store_dir],
+        capture_output=True, text=True, env=env, cwd=ROOT)
+    try:
+        doc = json.loads(r.stdout)
+    except ValueError:
+        doc = None
+    return r.returncode, doc
+
+
+def _submit_all(url: str, contracts):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import serve_client
+
+    return serve_client.get_result(
+        url, serve_client.submit(url, contracts, tenant="chaos")["id"],
+        wait=600.0)
+
+
+def _backfill_status(url: str) -> Dict:
+    """Poll-friendly ``/healthz backfill`` read: a daemon mid-compile
+    holds the GIL hard enough on a loaded CPU box to starve its HTTP
+    threads past the client's socket timeout — that is slowness, not
+    death, so the poll loop swallows it and asks again."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import serve_client
+
+    try:
+        return serve_client.healthz(url).get("backfill") or {}
+    except OSError:
+        return {}
+
+
+def _final_shape(final) -> Tuple[int, List[str]]:
+    """(verdicts served from the dedupe store, sorted issue names)."""
+    results = final["results"]
+    from_store = sum(1 for r in results
+                     if r.get("served_from") == "dedupe-store")
+    issues = sorted(i["contract"] for r in results
+                    for i in (r.get("issues") or []))
+    return from_store, issues
+
+
+def _cell_store_kill_compaction(d: str, contracts,
+                                baseline: List[str]) -> Dict:
+    """Die (os._exit, SIGKILL-equivalent) at each of the compaction
+    protocol's three points in sequence — segment durable but manifest
+    not, manifest durable but loose files not yet unlinked, and the
+    store-level fold just before the unlink sweep. After EVERY kill
+    the store must verify clean (all verdicts readable from one tier
+    or the other), and the final clean pass must converge: every key
+    in the manifest, zero loose files, and a fresh replica answering
+    the whole corpus from segments alone."""
+    import signal
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import serve_client
+
+    dd = os.path.join(d, "sd")
+    pa, url_a = _start_replica(d, "a", dd)
+    try:
+        first = _submit_all(url_a, contracts)
+    finally:
+        pa.send_signal(signal.SIGTERM)
+        pa.wait(timeout=60)
+    store_dir = os.path.join(dd, "store")
+    kills: List[int] = []
+    verifies: List[bool] = []
+    for point in ("after-segment", "after-manifest", "before-unlink"):
+        rc, _ = _store_admin("compact", store_dir, kill=point)
+        kills.append(rc)
+        rc, rep = _store_admin("verify", store_dir)
+        verifies.append(rc == 0 and bool(rep and rep.get("ok")))
+    rc_final, _ = _store_admin("compact", store_dir)
+    _, stats = _store_admin("stats", store_dir)
+    pb, url_b = _start_replica(d, "b", dd)
+    try:
+        final = _submit_all(url_b, contracts)
+    finally:
+        pb.send_signal(signal.SIGTERM)
+        pb.wait(timeout=60)
+    from_store, issues = _final_shape(final)
+    cell = {"kills": kills, "verifies": verifies,
+            "final_compact_rc": rc_final, "stats": stats,
+            "from_store": from_store,
+            "completed": final["completed"], "issues": issues}
+    cell["ok"] = (first["state"] == "done"
+                  and kills == [9, 9, 9]          # every point fired
+                  and all(verifies)               # readable after each
+                  and rc_final == 0
+                  and stats is not None
+                  and stats.get("loose_keys") == 0
+                  and stats.get("segment_keys") == N
+                  and stats.get("generation", 0) >= 1
+                  and final["state"] == "done"
+                  and final["completed"] == N
+                  and from_store == N             # all from segments
+                  and issues == baseline)
+    return cell
+
+
+def _cell_store_torn_segment(d: str, contracts,
+                             baseline: List[str]) -> Dict:
+    """A committed segment file torn mid-byte: the next replica must
+    quarantine it ``.corrupt`` on first read (checksum, not a parse
+    error 500), re-analyze its keys with issue parity intact, and a
+    re-compaction afterwards must heal the store to a clean new
+    generation."""
+    import re
+    import signal
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import serve_client
+
+    dd = os.path.join(d, "sd")
+    pa, url_a = _start_replica(d, "a", dd)
+    try:
+        _submit_all(url_a, contracts)
+    finally:
+        pa.send_signal(signal.SIGTERM)
+        pa.wait(timeout=60)
+    store_dir = os.path.join(dd, "store")
+    rc_compact, _ = _store_admin("compact", store_dir)
+    seg_dir = os.path.join(store_dir, "segments")
+    segs = sorted(f for f in os.listdir(seg_dir)
+                  if f.startswith("seg-") and f.endswith(".json"))
+    torn = os.path.join(seg_dir, segs[0]) if segs else None
+    if torn:
+        raw = open(torn, "rb").read()
+        with open(torn, "wb") as fh:
+            fh.write(raw[:len(raw) // 2])
+    pb, url_b = _start_replica(d, "b", dd)
+    try:
+        final = _submit_all(url_b, contracts)
+        met = serve_client.metrics(url_b)
+    finally:
+        pb.send_signal(signal.SIGTERM)
+        pb.wait(timeout=60)
+    m = re.search(r"^mythril_serve_store_segment_corrupt_total (\d+)",
+                  met, re.MULTILINE)
+    corrupt = int(m.group(1)) if m else 0
+    quarantined = any(f.endswith(".corrupt")
+                      for f in os.listdir(seg_dir))
+    # the re-analyzed verdicts land loose; a re-compaction heals the
+    # store to a clean generation that verifies end to end
+    rc_heal, _ = _store_admin("compact", store_dir)
+    rc_verify, rep = _store_admin("verify", store_dir)
+    from_store, issues = _final_shape(final)
+    cell = {"tore": bool(torn), "segment_corrupt": corrupt,
+            "quarantined": quarantined, "from_store": from_store,
+            "completed": final["completed"], "issues": issues,
+            "healed": rc_heal == 0 and rc_verify == 0}
+    cell["ok"] = (rc_compact == 0 and torn is not None
+                  and corrupt >= 1 and quarantined
+                  and final["state"] == "done"
+                  and final["completed"] == N
+                  and from_store == 0             # every key re-ran
+                  and issues == baseline
+                  and rc_heal == 0 and rc_verify == 0
+                  and bool(rep and rep.get("ok")))
+    return cell
+
+
+def _chain_node(contracts):
+    """Canned loopback JSON-RPC chain for the backfill cell: contract
+    ``i`` is deployed in block ``i+1``, head == len(contracts).
+    Returns ``(server, url, head)``."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    head = len(contracts)
+    blocks: Dict[int, List[Dict]] = {}
+    receipts: Dict[str, Dict] = {}
+    codes: Dict[str, str] = {}
+    for i, (_name, code) in enumerate(contracts):
+        n = i + 1
+        addr = "0x" + f"{n:02x}" * 20
+        txh = f"0xtx{n:04d}"
+        blocks[n] = [{"hash": txh, "to": None}]
+        receipts[txh] = {"contractAddress": addr}
+        codes[addr] = "0x" + code.hex()
+
+    class _Node(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            body = json.loads(
+                self.rfile.read(int(self.headers["Content-Length"])))
+            method, params = body["method"], body["params"]
+            if method == "eth_blockNumber":
+                result = hex(head)
+            elif method == "eth_getBlockByNumber":
+                n = int(params[0], 16)
+                result = ({"number": params[0],
+                           "transactions": blocks.get(n, [])}
+                          if n <= head else None)
+            elif method == "eth_getTransactionReceipt":
+                result = receipts.get(params[0])
+            elif method == "eth_getCode":
+                result = codes.get(params[0].lower(), "0x")
+            else:
+                result = None
+            data = json.dumps({"jsonrpc": "2.0", "id": body["id"],
+                               "result": result}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Node)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}", head
+
+
+def _cell_backfill_kill(d: str, contracts, baseline: List[str]) -> Dict:
+    """SIGKILL a ``serve --backfill`` daemon mid-walk (no drain, no
+    persist-on-exit). The restarted walker must resume from the
+    durable two-ended cursor — ``hi`` still anchored at the original
+    head, ``lo`` exactly where the last committed window left it — and
+    ingest ONLY the blocks below it (exactly-once: nothing already
+    committed is walked again), converging on one stored verdict per
+    historical contract with issue parity."""
+    import signal
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import serve_client
+
+    srv, rpc, head = _chain_node(contracts)
+    dd = os.path.join(d, "sd")
+    extra = ["--backfill", rpc, "--backfill-window", "1"]
+    cursor = os.path.join(dd, "backfill_cursor.json")
+    pre_lo = None
+    pa, url_a = _start_replica(d, "a", dd, extra=extra)
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            bf = _backfill_status(url_a)
+            lo = bf.get("lo")
+            if lo is not None and 1 <= lo <= head:
+                pre_lo = lo       # mid-walk: >=1 window committed,
+                break             # blocks below lo still unwalked
+            time.sleep(0.1)
+    finally:
+        pa.send_signal(signal.SIGKILL)
+        pa.wait(timeout=60)
+    lo_kill = json.load(open(cursor))["lo"]
+    b_status: Dict = {}
+    pb, url_b = _start_replica(d, "b", dd, extra=extra)
+    try:
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            b_status = _backfill_status(url_b) or b_status
+            if b_status.get("done"):
+                break
+            time.sleep(0.2)
+        final = _submit_all(url_b, contracts)
+    finally:
+        pb.send_signal(signal.SIGTERM)
+        pb.wait(timeout=60)
+        srv.shutdown()
+        srv.server_close()
+    cur = json.load(open(cursor))
+    from_store, issues = _final_shape(final)
+    cell = {"pre_kill_lo": pre_lo, "lo_after_kill": lo_kill,
+            "resumed": b_status, "cursor": cur,
+            "from_store": from_store,
+            "completed": final["completed"], "issues": issues}
+    cell["ok"] = (pre_lo is not None
+                  and 0 <= lo_kill <= head
+                  and b_status.get("done") is True
+                  and cur["lo"] == 0 and cur["hi"] == head
+                  # exactly-once: the resumed walker ingested ONLY the
+                  # blocks below the durable cursor (one deploy each)
+                  and b_status.get("ingested") == max(0, lo_kill - 1)
+                  and final["state"] == "done"
+                  and final["completed"] == N
+                  and from_store == N             # all precomputed
+                  and issues == baseline)
+    return cell
+
+
 def run_cell(mode: str, point: str, contracts,
              baseline: List[str]) -> Dict:
     with tempfile.TemporaryDirectory() as d:
@@ -600,6 +915,12 @@ def run_cell(mode: str, point: str, contracts,
             return _cell_replica_kill(d, contracts, baseline)
         if mode == "replica" and point == "torn-store-verdict":
             return _cell_replica_torn_store(d, contracts, baseline)
+        if mode == "store" and point == "kill-mid-compaction":
+            return _cell_store_kill_compaction(d, contracts, baseline)
+        if mode == "store" and point == "torn-segment":
+            return _cell_store_torn_segment(d, contracts, baseline)
+        if mode == "store" and point == "kill-mid-backfill-window":
+            return _cell_backfill_kill(d, contracts, baseline)
         raise ValueError(f"cell {mode}:{point} is not in the matrix")
 
 

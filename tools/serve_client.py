@@ -33,30 +33,49 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 MAX_BACKOFF_S = 15.0
 
 
+def _retry_after(e: "urllib.error.HTTPError") -> Optional[float]:
+    """The server-computed ``Retry-After`` seconds, if parseable."""
+    try:
+        ra = float(e.headers.get("Retry-After", ""))
+    except (TypeError, ValueError, AttributeError):
+        return None
+    return ra if ra >= 0 else None
+
+
 def with_retry(fn: Callable[[], Dict], retries: int = 0,
                backoff: float = 0.5) -> Dict:
-    """Run ``fn`` with bounded retry on the failures a daemon RESTART
-    produces: connection refused/reset (the process is down), torn
-    responses (it died mid-reply), and HTTP 503 (it is draining —
-    ``Retry-After`` says come back). Exponential backoff with jitter
+    """Run ``fn`` with bounded retry on the failures a daemon's
+    LIFECYCLE produces: connection refused/reset (the process is
+    down), torn responses (it died mid-reply), HTTP 503 (it is
+    draining) and HTTP 429 (quota spent / queue full — the server
+    computes a ``Retry-After``, docs/serving.md). For 429 the
+    server-supplied ``Retry-After`` is honored (capped at
+    ``MAX_BACKOFF_S``); otherwise exponential backoff with jitter
     (``backoff * 2^attempt * uniform(0.5, 1.5)``, capped) so N clients
     don't stampede the moment the daemon returns. ``retries=0`` is
-    exactly the old raise-through behavior; anything else (400/404/429,
+    exactly the old raise-through behavior; anything else (400/404,
     ValueError) still raises immediately — those are the CALLER's
     bugs, not the daemon's lifecycle."""
     attempt = 0
     while True:
+        server_delay = None
         try:
             return fn()
         except urllib.error.HTTPError as e:
-            if e.code != 503 or attempt >= retries:
+            if e.code not in (503, 429) or attempt >= retries:
                 raise
+            if e.code == 429:
+                server_delay = _retry_after(e)
         except (urllib.error.URLError, ConnectionError,
                 http.client.HTTPException, TimeoutError):
             if attempt >= retries:
                 raise
-        delay = min(MAX_BACKOFF_S,
-                    backoff * (2 ** attempt) * (0.5 + random.random()))
+        if server_delay is not None:
+            delay = min(MAX_BACKOFF_S, server_delay)
+        else:
+            delay = min(MAX_BACKOFF_S,
+                        backoff * (2 ** attempt)
+                        * (0.5 + random.random()))
         time.sleep(delay)
         attempt += 1
 
@@ -77,7 +96,7 @@ def submit(base_url: str, contracts: Sequence[Tuple[str, bytes]],
            backoff: float = 0.5) -> Dict:
     """POST /v1/submit. Returns the submission snapshot (id +
     already-deduped results). Raises ``urllib.error.HTTPError`` on
-    429 (queue full) / 503 (draining) once ``retries`` connection/503
+    429 (queue full / quota spent) / 503 (draining) once ``retries``
     attempts are exhausted. NOTE a retried submit may re-admit work an
     earlier torn reply already queued — the dedupe store makes that
     idempotent (the resubmission serves from dedupe)."""
@@ -209,10 +228,11 @@ def main() -> int:
     ap.add_argument("--wait", type=float, default=300.0,
                     help="long-poll budget in seconds (default 300)")
     ap.add_argument("--retries", type=int, default=3, metavar="N",
-                    help="bounded retry on connection errors and 503 "
-                         "(a draining/restarting daemon), with "
-                         "exponential backoff + jitter (default 3; "
-                         "0 = fail fast)")
+                    help="bounded retry on connection errors, 503 (a "
+                         "draining/restarting daemon) and 429 (quota "
+                         "spent — honors the server's Retry-After), "
+                         "with exponential backoff + jitter "
+                         "(default 3; 0 = fail fast)")
     ap.add_argument("--backoff", type=float, default=0.5, metavar="SEC",
                     help="base retry backoff; attempt k sleeps "
                          "base*2^k with jitter, capped at "

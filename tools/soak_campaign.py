@@ -76,6 +76,16 @@ Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
      rest: every contract exactly once, issue parity with a batch
      run, and a final full resubmission to B answered 100% from
      dedupe (the merged exactly-once check).
+ 14. segments — the historical-index pipeline killed at every stage
+     (docs/serving.md "Verdict segments & edge replicas"): a
+     ``--backfill`` walker SIGKILLed mid-window must resume from the
+     durable two-ended cursor and ingest ONLY the blocks below it
+     (exactly-once across the kill); the compactor killed right after
+     the manifest commit must re-run to convergence (zero loose files,
+     every key in the manifest, no double-fold); a ``--store-only``
+     edge replica on the same data dir must then answer the whole
+     corpus from segments alone with issue parity and type the one
+     unknown bytecode as ``unknown-contract`` instead of 500ing.
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
@@ -134,7 +144,7 @@ N = 6  # even indices killable -> expected issues c000/c002/c004
 
 LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry",
         "pipeline", "fleet", "serve", "solver_store", "chaos",
-        "replicas", "tiers")
+        "replicas", "tiers", "segments")
 
 
 def write_corpus(d: str) -> str:
@@ -742,6 +752,124 @@ def main() -> int:
                    and not r2.quarantined
                    and legs["tiers"]["issues2"] == ["c000", "c002",
                                                     "c004"])
+
+        if "segments" in want:
+            # leg 14: kill->resume exactly-once across the whole
+            # historical-index pipeline — backfill walker, compactor,
+            # and the store-only edge replica that serves the result
+            import signal
+            import time as _time
+
+            sys.path.insert(0, os.path.join(ROOT, "tools"))
+            import chaos_campaign
+            import serve_client
+
+            contracts = [
+                (f"c{i:03d}",
+                 assemble(i, "SELFDESTRUCT") if i % 2 == 0
+                 else assemble(1, i, "SSTORE", "STOP"))
+                for i in range(N)]
+            srv, rpc, head = chaos_campaign._chain_node(contracts)
+            dd = os.path.join(d, "segments_data")
+            bf_extra = ["--backfill", rpc, "--backfill-window", "1"]
+            cursor = os.path.join(dd, "backfill_cursor.json")
+            # phase 1: SIGKILL the backfill walker mid-walk; the
+            # restart resumes from the durable cursor and ingests only
+            # the blocks below it
+            pre_lo = None
+            pa, url_a = chaos_campaign._start_replica(
+                d, "seg_a", dd, extra=bf_extra)
+            try:
+                deadline = _time.monotonic() + 300
+                while _time.monotonic() < deadline:
+                    bf = chaos_campaign._backfill_status(url_a)
+                    lo = bf.get("lo")
+                    if lo is not None and 1 <= lo <= head:
+                        pre_lo = lo
+                        break
+                    _time.sleep(0.1)
+            finally:
+                pa.send_signal(signal.SIGKILL)
+                pa.wait(timeout=60)
+            lo_kill = json.load(open(cursor))["lo"]
+            b_bf: dict = {}
+            pb, url_b = chaos_campaign._start_replica(
+                d, "seg_b", dd, extra=bf_extra)
+            try:
+                deadline = _time.monotonic() + 600
+                while _time.monotonic() < deadline:
+                    b_bf = chaos_campaign._backfill_status(
+                        url_b) or b_bf
+                    if b_bf.get("done"):
+                        break
+                    _time.sleep(0.2)
+            finally:
+                pb.send_signal(signal.SIGTERM)
+                pb.wait(timeout=60)
+                srv.shutdown()
+                srv.server_close()
+            cur = json.load(open(cursor))
+            # phase 2: kill the compactor right AFTER the manifest
+            # commit (fold durable, loose unlink never ran); the store
+            # must verify clean and the re-run must converge instead
+            # of double-folding
+            store_dir = os.path.join(dd, "store")
+            rc_kill, _ = chaos_campaign._store_admin(
+                "compact", store_dir, kill="after-manifest")
+            rc_verify, rep = chaos_campaign._store_admin(
+                "verify", store_dir)
+            rc_compact, _ = chaos_campaign._store_admin(
+                "compact", store_dir)
+            _, stats = chaos_campaign._store_admin("stats", store_dir)
+            # phase 3: an engine-free --store-only replica answers the
+            # backfilled corpus from segments alone and TYPES the one
+            # unknown bytecode
+            unknown = assemble(7, 7, "SSTORE", "STOP")
+            ps, url_s = chaos_campaign._start_replica(
+                d, "seg_s", dd, extra=["--store-only"])
+            try:
+                snap = serve_client.submit(
+                    url_s, contracts + [("mystery", unknown)],
+                    tenant="soak")
+                health = serve_client.healthz(url_s)
+            finally:
+                ps.send_signal(signal.SIGTERM)
+                ps.wait(timeout=60)
+            by_name = {r["name"]: r for r in snap["results"]}
+            issues = sorted(i["contract"] for r in snap["results"]
+                            for i in (r.get("issues") or []))
+            from_store = sorted(
+                n for n, r in by_name.items()
+                if r.get("served_from") == "dedupe-store")
+            legs["segments"] = {
+                "pre_kill_lo": pre_lo, "lo_after_kill": lo_kill,
+                "resumed": b_bf, "cursor": cur,
+                "compactor_kill_rc": rc_kill, "stats": stats,
+                "from_store": from_store, "issues": issues,
+                "mystery": by_name.get("mystery", {}).get("status"),
+                "store_only_health": {
+                    k: health.get(k)
+                    for k in ("store_only", "store_generation", "ok")}}
+            ok &= (pre_lo is not None and 0 <= lo_kill <= head
+                   and b_bf.get("done") is True
+                   and cur["lo"] == 0 and cur["hi"] == head
+                   # exactly-once: only the blocks below the durable
+                   # cursor were walked again (one deploy per block)
+                   and b_bf.get("ingested") == max(0, lo_kill - 1)
+                   and rc_kill == 9 and rc_verify == 0
+                   and bool(rep and rep.get("ok"))
+                   and rc_compact == 0 and stats is not None
+                   and stats.get("loose_keys") == 0
+                   and stats.get("segment_keys") == N
+                   and snap["state"] == "done"
+                   and from_store == [f"c{i:03d}" for i in range(N)]
+                   and issues == ["c000", "c002", "c004"]
+                   and by_name["mystery"]["status"]
+                   == "unknown-contract"
+                   and by_name["mystery"].get("retry_after", 0) > 0
+                   and health.get("store_only") is True
+                   and health.get("store_generation") == 1
+                   and health.get("ok") is True)
 
         if "chaos" in want:
             # leg 11: the reduced chaos matrix (one engine-worker

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Offline admin for the serve verdict store (docs/serving.md
+"Verdict segments & edge replicas").
+
+Operates directly on a ``--data-dir``'s ``store/`` directory — no
+daemon needed, stdlib + serve-layer imports only (no jax, no engine):
+
+    python tools/store_admin.py verify  --store serve_data/store
+    python tools/store_admin.py compact --store serve_data/store
+    python tools/store_admin.py stats   --store serve_data/store
+
+``verify``   read-only integrity sweep: checksum every manifest-
+             referenced segment (whole-file + per-record) and every
+             loose verdict file; reports corruption, quarantines
+             NOTHING (safe on a live store; exit 1 if anything is
+             corrupt).
+``compact``  one-shot compaction: fold settled loose files into a new
+             segment + manifest generation, then unlink them — the
+             offline alternative to ``serve --compact-every`` (run it
+             from cron on the ONE host allowed to compact a shared
+             data dir).
+``stats``    generation number, per-segment key counts, loose tally,
+             and the bytecode dedupe ratio (keys per distinct
+             bytecode — how much clone/proxy dominance is saving).
+
+Each subcommand prints one JSON document; importable functions
+(``cmd_verify`` / ``cmd_compact`` / ``cmd_stats``) are exercised by
+tests/test_segstore.py so the tool can't rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from mythril_tpu.serve.segstore import LOOSE_RE  # noqa: E402
+from mythril_tpu.serve.store import ResultsStore  # noqa: E402
+
+
+def _loose_files(store_dir: str):
+    try:
+        names = sorted(os.listdir(store_dir))
+    except OSError:
+        return
+    for fn in names:
+        if LOOSE_RE.match(fn):
+            yield fn
+
+
+def cmd_verify(store_dir: str) -> Dict:
+    """Checksum every segment and validate every loose file,
+    read-only. ``corrupt`` lists every problem found."""
+    store = ResultsStore(store_dir)
+    report = store.segments.verify()
+    report["loose"] = 0
+    for fn in _loose_files(store_dir):
+        key = fn[:-len(".json")]
+        p = os.path.join(store_dir, fn)
+        try:
+            with open(p) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            report["corrupt"].append({"file": fn, "why": "json"})
+            continue
+        if not store._valid_key_doc(key, doc):
+            report["corrupt"].append({"file": fn, "why": "key-mismatch"})
+            continue
+        report["loose"] += 1
+    report["ok"] = not report["corrupt"]
+    return report
+
+
+def cmd_compact(store_dir: str) -> Dict:
+    """One compaction pass (crash-safe at any instant — see
+    docs/serving.md for the protocol)."""
+    return ResultsStore(store_dir).compact()
+
+
+def cmd_stats(store_dir: str) -> Dict:
+    """Shape of the store: generation, per-tier key counts, and the
+    bytecode dedupe ratio."""
+    store = ResultsStore(store_dir)
+    seg_keys = store.segments.keys()
+    loose_keys = [fn[:-len(".json")] for fn in _loose_files(store_dir)]
+    all_keys = set(seg_keys) | set(loose_keys)
+    distinct_bch = {k.partition(".")[0] for k in all_keys}
+    return {
+        "generation": store.generation(),
+        "segments": [
+            {"file": s.get("file"), "count": s.get("count")}
+            for s in store.segments._segments],
+        "segment_keys": len(seg_keys),
+        "loose_keys": len(loose_keys),
+        "total_keys": len(all_keys),
+        "distinct_bytecodes": len(distinct_bch),
+        "bytecode_dedupe_ratio": round(
+            len(all_keys) / max(1, len(distinct_bch)), 3),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("verify", "compact", "stats"):
+        p = sub.add_parser(name)
+        p.add_argument("--store", required=True, metavar="DIR",
+                       help="the store directory "
+                            "(<data-dir>/store)")
+    args = ap.parse_args()
+    fn = {"verify": cmd_verify, "compact": cmd_compact,
+          "stats": cmd_stats}[args.cmd]
+    out = fn(args.store)
+    print(json.dumps(out, indent=1, sort_keys=True))
+    if args.cmd == "verify" and not out["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
