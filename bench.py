@@ -17,6 +17,19 @@ the honest stand-in for the reference's per-state Python interpreter loop
     on a batch of contracts (BASELINE config-2 shape, single chip);
   - paths_per_sec: live paths explored per second in that run;
   - solver: host witness-search statistics (attempts/sat/unknown/time).
+
+Modes (each keeps the one-record-per-line contract):
+  - ``BENCH_SWEEP=1``: per-P lane-scaling records for the symbolic
+    engine (``BENCH_SWEEP_P`` overrides the P list);
+  - ``BENCH_E2E=1``: full CorpusCampaign over a synthetic corpus
+    (tools/gen_corpus MIX, ``BENCH_E2E_N`` contracts) — headline
+    ``analyze_contracts_per_min`` + device/host/other stage wall
+    breakdown. Standalone it rides in ``extra``; combined with
+    ``BENCH_SWEEP`` it adds per-P e2e records (deepest-P legs are
+    skipped first under budget pressure, as recorded skips);
+  - ``BENCH_SCALING=1``: compiled-cost attribution (tools/
+    scaling_report.py) — fitted per-phase growth exponents from jaxpr
+    traces, no execution, hardware-independent.
 """
 
 from __future__ import annotations
@@ -195,6 +208,61 @@ def bench_analyze() -> dict:
     }
 
 
+def bench_e2e(p_total: int = 1024) -> dict:
+    """``BENCH_E2E=1`` end-to-end campaign benchmark: a full
+    :class:`CorpusCampaign` (checkpointless) over an N-contract synthetic
+    corpus built from tools/gen_corpus's generator MIX — the whole
+    ingestion→explore→solve→verdict pipeline, not just the engine — and
+    the headline is the ROADMAP's operator metric: contracts/min. The
+    same number the campaign heartbeat prints and serve /metrics exports
+    (``campaign_contracts_per_min`` / ``serve_contracts_per_min``), so
+    bench records, live telemetry and dashboards are one comparable
+    series. ``BENCH_E2E_N`` overrides the corpus size; ``p_total`` sets
+    the device lane budget (batch_size × lanes_per_contract), which is
+    how the sweep drives the e2e legs across the P-curve."""
+    from mythril_tpu.mythril.campaign import CorpusCampaign
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import gen_corpus
+
+    small = bool(os.environ.get("MYTHRIL_BENCH_SMALL"))
+    n = int(os.environ.get("BENCH_E2E_N", "8" if small else "24"))
+    mix = gen_corpus.MIX
+    contracts = [("e2e%04d_%s" % (i, mix[i % len(mix)].__name__),
+                  mix[i % len(mix)](i)) for i in range(n)]
+    bs = min(8, n)
+    lanes = max(4, p_total // bs)
+    camp = CorpusCampaign(contracts, batch_size=bs,
+                          lanes_per_contract=lanes,
+                          max_steps=SYM_MAX_STEPS, transaction_count=1)
+    # stage attribution: _exec_batch accumulates device/host phase wall
+    # into this dict when present (the serve path does the same)
+    camp._phase_acc = {"device": 0.0, "host": 0.0}
+    with obs_trace.timer("bench.e2e", contracts=n, P=bs * lanes):
+        res = camp.run()
+    d = res.as_dict()
+    wall = d["wall_sec"]
+    phases = {k: round(v, 3) for k, v in camp._phase_acc.items()}
+    phases["other"] = round(
+        max(0.0, wall - sum(camp._phase_acc.values())), 3)
+    return {
+        "analyze_contracts_per_min": d["contracts_per_min"],
+        "e2e": {
+            "contracts": d["contracts"],
+            "batches": d["batches"],
+            "issues": d["issues"],
+            "P": bs * lanes,
+            "wall_sec": wall,
+            # first batch is compile-dominated; the steady rate is the
+            # long-campaign projection
+            "contracts_per_min_steady": round(
+                d["contracts_per_sec_steady"] * 60.0, 2),
+            "phases": phases,
+        },
+    }
+
+
 def bench_sweep(remaining) -> None:
     """``BENCH_SWEEP=1`` lane-scaling sweep: the SYMBOLIC engine at
     P ∈ {1024, 4096, 16384} (override: ``BENCH_SWEEP_P=comma,list``),
@@ -230,6 +298,35 @@ def bench_sweep(remaining) -> None:
                           "platform": plat,
                           "tier": tier_of_platform(plat),
                           "extra": rec}), flush=True)
+    if os.environ.get("BENCH_E2E"):
+        # e2e legs ride AFTER the engine sweep and climb P ascending, so
+        # when the budget tightens the deepest-P e2e legs are the first
+        # sacrificed — and each sacrifice is a recorded skip, never a
+        # silent hole in the P-curve
+        from mythril_tpu.backend import tier_of_platform
+        plat = jax.default_backend()
+        for p in ps:
+            if remaining() < 180:
+                print(json.dumps({"metric": "analyze_contracts_per_min",
+                                  "P": p,
+                                  "skipped": "budget: %.0fs left"
+                                             % remaining()}), flush=True)
+                continue
+            try:
+                with obs_trace.timer("bench.sweep_e2e", P=p):
+                    rec = bench_e2e(p_total=p)
+            except Exception as e:
+                print(json.dumps({"metric": "analyze_contracts_per_min",
+                                  "P": p, "error": repr(e)[:300]}),
+                      flush=True)
+                continue
+            print(json.dumps({"metric": "analyze_contracts_per_min",
+                              "P": p,
+                              "value": rec["analyze_contracts_per_min"],
+                              "unit": "contracts/min",
+                              "platform": plat,
+                              "tier": tier_of_platform(plat),
+                              "extra": rec["e2e"]}), flush=True)
 
 
 def _run_sweep_per_tier(tiers, remaining) -> None:
@@ -495,6 +592,43 @@ def main():
             return
 
     _lazy_imports()
+    if os.environ.get("BENCH_SCALING"):
+        # compiled-cost attribution mode (tools/scaling_report.py): trace
+        # the engine's jaxprs at the sweep's P values and emit the fitted
+        # growth exponent per phase bucket — pure tracing, no execution,
+        # so the record is hardware-independent (the perf trajectory can
+        # watch for superlinear terms even on a CPU-only round)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import scaling_report
+        ps = tuple(int(x) for x in
+                   os.environ.get("BENCH_SWEEP_P", "1024,4096,16384")
+                   .split(",") if x.strip())
+        for impl in ("legacy", "packed"):
+            if remaining() < 60:
+                print(json.dumps({"metric": "scaling_attribution",
+                                  "fork_impl": impl,
+                                  "skipped": "budget: %.0fs left"
+                                             % remaining()}), flush=True)
+                continue
+            try:
+                rep = scaling_report.attribution(ps, fork_impl=impl)
+            except Exception as e:
+                print(json.dumps({"metric": "scaling_attribution",
+                                  "fork_impl": impl,
+                                  "error": repr(e)[:300]}), flush=True)
+                continue
+            print(json.dumps({
+                "metric": "scaling_attribution", "fork_impl": impl,
+                "value": rep["superstep_body_exponent"], "unit": "exponent",
+                "dominant_superlinear": rep["dominant_superlinear"],
+                "extra": {n: {"exponent": b["exponent"],
+                              "elems_max_p": b["elems"][ps[-1]]}
+                          for n, b in rep["buckets"].items()}}), flush=True)
+        sw.stop()
+        with _EMIT_LOCK:
+            _EMITTED = True
+        return
     if os.environ.get("BENCH_SWEEP"):
         # lane-scaling sweep mode: per-P records instead of the single
         # headline line; suppress the watchdog's error-shaped emit —
@@ -533,6 +667,16 @@ def main():
                 extra["analyze_error"] = repr(e)[:200]
         else:
             extra["analyze_skipped"] = "budget: %.0fs left" % remaining()
+    if os.environ.get("BENCH_E2E"):
+        # full-pipeline campaign leg: the ROADMAP's contracts/min
+        # headline rides in extra next to the engine-only numbers
+        if remaining() > 180:
+            try:
+                extra.update(bench_e2e())
+            except Exception as e:
+                extra["e2e_error"] = repr(e)[:200]
+        else:
+            extra["e2e_skipped"] = "budget: %.0fs left" % remaining()
     if not os.environ.get("MYTHRIL_BENCH_NO_PROFILE"):
         if remaining() > 120:
             try:

@@ -27,7 +27,7 @@ from ..smt.solver import solve_tape
 from ..smt.tape import (HostNode, HostTape, TapeHostCache, extract_tape,
                         intern_node)
 from ..symbolic import SymSpec, between_txs, make_sym_frontier, sym_run
-from ..symbolic.engine import rebalance_parked
+from ..symbolic.engine import rebalance_parked, sym_run_donated
 
 log = logging.getLogger(__name__)
 
@@ -257,8 +257,13 @@ class SymExecWrapper:
         dyn_loader=None,
         dynld_limit: int = 4,
         warm_shapes: Optional[set] = None,
+        fork_impl: Optional[str] = None,
+        unroll: Optional[int] = None,
     ):
+        import os as _os
         import time as _time
+
+        import jax
 
         from ..core.frontier import CREATOR_ADDRESS
         from ..plugin.loader import LaserPluginLoader
@@ -300,6 +305,26 @@ class SymExecWrapper:
         # other blocks' free slots between chunks
         self.spill = spill
         self.fork_block = fork_block
+        # superstep restructure knobs (docs/performance.md "Scaling
+        # cliff"): fork slot-mapping machinery + supersteps rolled per
+        # while-loop body. Env overrides exist so campaigns / benches
+        # can A/B without plumbing a parameter through every layer.
+        self.fork_impl = (fork_impl
+                          or _os.environ.get("MYTHRIL_FORK_IMPL")
+                          or "packed")
+        self.unroll = int(unroll if unroll is not None
+                          else _os.environ.get("MYTHRIL_SYM_UNROLL")
+                          or 1)
+        # buffer donation on the chunk loop's sym_run calls: the loop
+        # consumes each input frontier, so the engine may alias input
+        # buffers into outputs (halves peak frontier memory on
+        # accelerators). OPT-IN (MYTHRIL_DONATE=1): between_txs and the
+        # plugin/checkpoint seams run EAGERLY, so an untouched leaf of a
+        # donated frontier can still be shared with a kept
+        # AnalysisContext — only enable when no plugin retains frontier
+        # references across chunks. CPU ignores donation entirely.
+        self._donate = (_os.environ.get("MYTHRIL_DONATE") == "1"
+                        and jax.default_backend() != "cpu")
         # in-jit cross-block migration (SURVEY §5.8 ICI tier): only
         # meaningful when fork compaction is blocked (fork_block > 0) and
         # spill parks starved lanes; a no-op otherwise (and inside
@@ -394,17 +419,20 @@ class SymExecWrapper:
             checkpoint."""
             import time as _time
 
+            runner = sym_run_donated if self._donate else sym_run
             if (self._deadline_at is None and self.checkpoint_dir is None
                     and not self.spill):
                 # execute + fork fuse inside the jitted superstep loop;
                 # the host-visible unit (and the span) is the whole call
                 with obs_trace.span("superstep", tx=self._cur_tx,
                                     steps=max_steps):
-                    sf, vis = sym_run(sf, env, self.corpus, spec, limits,
-                                      max_steps=max_steps,
-                                      track_coverage=True,
-                                      fork_policy=self.fork_policy,
-                                      fork_block=self.fork_block)
+                    sf, vis = runner(sf, env, self.corpus, spec, limits,
+                                     max_steps=max_steps,
+                                     track_coverage=True,
+                                     fork_policy=self.fork_policy,
+                                     fork_block=self.fork_block,
+                                     fork_impl=self.fork_impl,
+                                     unroll=self.unroll)
                 self._visited |= np.asarray(vis)
                 return sf
             steps_done = 0
@@ -432,13 +460,15 @@ class SymExecWrapper:
                 with obs_trace.timer("superstep", tx=self._cur_tx,
                                      steps=n, done=steps_done,
                                      cold=cold) as sp:
-                    sf, vis = sym_run(
+                    sf, vis = runner(
                         sf, env, self.corpus, spec, limits,
                         max_steps=n,
                         track_coverage=True, fork_policy=self.fork_policy,
                         fork_block=self.fork_block,
                         defer_starved=self.spill,
-                        migrate_every=self.migrate_every)
+                        migrate_every=self.migrate_every,
+                        fork_impl=self.fork_impl,
+                        unroll=self.unroll)
                 self._visited |= np.asarray(vis)
                 # a shape's first run pays XLA compilation — not a sample
                 if cold:
@@ -450,15 +480,24 @@ class SymExecWrapper:
                     sec_per_step = max(sec_per_step, sp.elapsed / n)
                 obs_metrics.REGISTRY.counter("engine_supersteps_total").inc(n)
                 steps_done += n
-                # ONE device→host fetch of (active, fork_req) per chunk
-                # boundary, shared by the rebalance planner and the
-                # telemetry gauges — each np.asarray is a blocking sync,
-                # and the gauges used to pay a second one of their own
+                # ONE device→host transfer per chunk boundary, shared by
+                # EVERY seam consumer: the rebalance planner, the
+                # telemetry gauges, AND the loop's quiescence check ride
+                # the same (active, fork_req, running) fetch. Each
+                # separate np.asarray is a blocking sync — the quiescence
+                # check used to pay its own regardless of cadence (the
+                # "refetch on every seam" gap), and now only a bare run
+                # with telemetry off and spill off falls back to the
+                # single running read. (Reusing the pre-rebalance fetch
+                # for the quiescence check is exact: rebalance RELOCATES
+                # lanes — it never changes whether any lane is running.)
                 act_h = freq_h = None
                 if self.spill or (obs_metrics.REGISTRY.enabled
                                   or obs_trace.active()):
-                    act_h = np.asarray(sf.base.active)
-                    freq_h = np.asarray(sf.fork_req)
+                    act_h, freq_h, run_h = jax.device_get(
+                        (sf.base.active, sf.fork_req, sf.base.running))
+                else:
+                    run_h = np.asarray(sf.base.running)
                 if self.spill:
                     with obs_trace.span("rebalance", tx=self._cur_tx):
                         sf, moved = rebalance_parked(sf, self.fork_block,
@@ -472,7 +511,7 @@ class SymExecWrapper:
                 self.plugin_loader.fire("on_chunk", sf, steps_done)
                 if self.checkpoint_dir is not None:
                     self._save_checkpoint(sf, steps_done)
-                if not bool(np.asarray(sf.base.running).any()):
+                if not bool(run_h.any()):
                     break
                 if (self._deadline_at is not None
                         and _time.monotonic() >= self._deadline_at):
@@ -487,8 +526,8 @@ class SymExecWrapper:
                 with obs_trace.span("drain", tx=self._cur_tx):
                     # one fetch per drain round, shared with the
                     # rebalance planner and the final parked count
-                    act_h = np.asarray(sf.base.active)
-                    freq_h = np.asarray(sf.fork_req)
+                    act_h, freq_h = jax.device_get(
+                        (sf.base.active, sf.fork_req))
                     parked = freq_h & act_h
                     for _ in range(4):
                         if not parked.any():
@@ -506,17 +545,19 @@ class SymExecWrapper:
                             "rebalanced_lanes_total").inc(moved)
                         with obs_trace.span("superstep", tx=self._cur_tx,
                                             steps=self._chunk, drain=True):
-                            sf, vis = sym_run(
+                            sf, vis = runner(
                                 sf, env, self.corpus, spec, limits,
                                 max_steps=self._chunk,
                                 track_coverage=True,
                                 fork_policy=self.fork_policy,
                                 fork_block=self.fork_block,
                                 defer_starved=True,
-                                migrate_every=self.migrate_every)
+                                migrate_every=self.migrate_every,
+                                fork_impl=self.fork_impl,
+                                unroll=self.unroll)
                         self._visited |= np.asarray(vis)
-                        act_h = np.asarray(sf.base.active)
-                        freq_h = np.asarray(sf.fork_req)
+                        act_h, freq_h = jax.device_get(
+                            (sf.base.active, sf.fork_req))
                         parked = freq_h & act_h
                 # forks still parked after draining are lost coverage —
                 # count them in the drop channel for honesty (reusing

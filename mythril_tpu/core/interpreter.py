@@ -110,6 +110,27 @@ def _peek(f: Frontier, i) -> jnp.ndarray:
     return jnp.take_along_axis(f.stack, idx[:, None, None].astype(I32), axis=1)[:, 0]
 
 
+# tools/scaling_report.py forces a specific write strategy when TRACING
+# cost models on a backend that is not the deployment target (the TPU
+# tunnel being down must not block attributing the TPU-path op counts
+# from a CPU box). None = backend-adaptive (the only mode used at run
+# time); "scatter"/"dense" pin the strategy for the next trace. Set via
+# force_write_mode() around a jaxpr trace, never around real execution.
+_WRITE_MODE_OVERRIDE = None
+
+
+def force_write_mode(mode):
+    """Pin (``"scatter"``/``"dense"``) or restore (``None``) the slot-
+    write strategy :func:`_use_scatter` reports. Trace-time analysis
+    only — returns the previous value so callers can restore it."""
+    global _WRITE_MODE_OVERRIDE
+    prev = _WRITE_MODE_OVERRIDE
+    if mode not in (None, "scatter", "dense"):
+        raise ValueError(f"unknown write mode: {mode!r}")
+    _WRITE_MODE_OVERRIDE = mode
+    return prev
+
+
 def _use_scatter() -> bool:
     """Slot-write strategy, resolved once at trace time (cf.
     ``default_cond_classes``): XLA:CPU lowers per-lane dynamic scatters
@@ -118,6 +139,8 @@ def _use_scatter() -> bool:
     round-3 scatter rewrite took the concrete interpreter from 1.05M to
     0.149M lane-steps/s (7x). Dense one-hot compare-selects keep every
     write a fusable vector op on TPU."""
+    if _WRITE_MODE_OVERRIDE is not None:
+        return _WRITE_MODE_OVERRIDE == "scatter"
     return jax.default_backend() == "cpu"
 
 

@@ -153,6 +153,11 @@ class CampaignResult:
             "contracts_per_sec": round(
                 self.contracts / total, 3) if total else 0.0,
             "contracts_per_sec_steady": steady_rate,
+            # the headline end-to-end metric (ROADMAP "contracts/min"):
+            # same ratio, operator-scale units — benches, heartbeats and
+            # serve /metrics all quote this one
+            "contracts_per_min": round(
+                self.contracts / total * 60.0, 2) if total else 0.0,
             "paths_total": self.paths_total,
             "paths_per_sec": round(
                 self.paths_total / total, 1) if total else 0.0,
@@ -1259,6 +1264,15 @@ class CorpusCampaign:
         wall = sum(res.batch_wall)
         contracts = min(done * self.batch_size, len(self.contracts))
         pps = res.paths_total / wall if wall else 0.0
+        # contracts/min: the end-to-end headline rate (ROADMAP "Kill the
+        # P-scaling cliff" makes it the number next to lane-steps/s) —
+        # published as a gauge too, so serve /metrics and the heartbeat
+        # quote the same figure
+        cpm = contracts / wall * 60.0 if wall else 0.0
+        obs_metrics.REGISTRY.gauge(
+            "campaign_contracts_per_min",
+            help="end-to-end analyzed contracts per minute "
+                 "(batch walls, campaign scope)").set(round(cpm, 2))
         # occupancy: the engine gauge when telemetry collected it this
         # chunk, else a lane-capacity estimate from the last batch
         occ = obs_metrics.REGISTRY.gauge("frontier_occupancy").value
@@ -1297,12 +1311,14 @@ class CorpusCampaign:
             req_p50, req_p95 = rh.quantile(0.5), rh.quantile(0.95)
             rq = f" req p50 {req_p50:.2f}s/p95 {req_p95:.2f}s"
         print(f"heartbeat: batch {done}/{total} contracts {contracts}/"
-              f"{len(self.contracts)} paths/s {pps:.1f} frontier "
-              f"{100.0 * occ:.0f}% rung {rung} z3-avoid {z3av:.0f}% "
+              f"{len(self.contracts)} c/min {cpm:.1f} paths/s {pps:.1f} "
+              f"frontier {100.0 * occ:.0f}% rung {rung} "
+              f"z3-avoid {z3av:.0f}% "
               f"ckpt-age {age_s}{wk}{tk}{rq}",
               file=sys.stderr, flush=True)
         obs_trace.event("heartbeat", batch=done, batches_total=total,
                         contracts=contracts,
+                        contracts_per_min=round(cpm, 2),
                         paths_per_sec=round(pps, 1),
                         occupancy=round(occ, 4), rung=rung,
                         z3_avoided_pct=z3av,

@@ -130,6 +130,14 @@ class Scheduler:
         #: its deadline
         self.crashed: Optional[str] = None
         self._reg = obs_metrics.REGISTRY
+        #: commit accounting for the serve_contracts_per_min gauge: one
+        #: (monotonic time, n_contracts) sample per committed batch,
+        #: pruned to the trailing window. The headline end-to-end rate
+        #: (ROADMAP "contracts/min") as production sees it — fed by
+        #: verdict commits, not engine internals, so fleet-committed and
+        #: resident batches count the same way.
+        self._commit_log: List[tuple] = []
+        self._commit_window = 300.0
 
     # --- lifecycle ------------------------------------------------------
     def start(self) -> None:
@@ -311,6 +319,25 @@ class Scheduler:
                            batch=out.get("batch"),
                            batch_status=str(out.get("status", "ok")))
 
+    def _note_commits(self, n: int) -> None:
+        """Record ``n`` contract verdicts committed now and refresh the
+        ``serve_contracts_per_min`` gauge over the trailing window."""
+        now = time.monotonic()
+        self._commit_log.append((now, n))
+        cut = now - self._commit_window
+        while self._commit_log and self._commit_log[0][0] < cut:
+            self._commit_log.pop(0)
+        total = sum(c for _, c in self._commit_log)
+        # rate over the observed span (first sample to now), floored at
+        # one second so a burst of early commits cannot print as an
+        # absurd rate; a single sample reports over the full window
+        span = max(1.0, now - self._commit_log[0][0]) \
+            if len(self._commit_log) > 1 else self._commit_window
+        self._reg.gauge(
+            "serve_contracts_per_min",
+            help="contract verdicts committed per minute "
+                 "(trailing window)").set(round(total * 60.0 / span, 2))
+
     def _bind_results(self, entries: List[Entry], issues: List[Dict],
                       quarantined: List[Dict],
                       batch=None, batch_status: str = "ok") -> None:
@@ -353,6 +380,7 @@ class Scheduler:
             res = dict(verdict)
             res["batch"] = batch
             self.queue.resolve(e, res)
+        self._note_commits(len(entries))
 
     # --- fleet-fed execution (docs/fleet.md) ----------------------------
     def _feed_batch(self, entries: List[Entry]) -> None:

@@ -2201,6 +2201,30 @@ _MISC_WRITES = (
     "mem_havoc", "rv_havoc",
 ) + _TAPE_WRITES
 
+# pop_frames' declared write set: everything the caller-restore touches —
+# but NOT the fr_* frame stacks ([P, D, ...] snapshots, read-only here),
+# the tape, kb_m/kb_v, or the con_* constraint arrays. Keeping those out
+# of the cond boundary matters: the old full-state ``lax.cond`` carried
+# every leaf of the frontier (frame-stack snapshots alone are D× the base
+# state) through the boundary on EVERY superstep — one of the cond-copy
+# buckets tools/scaling_report.py attributes.
+_POP_FRAME_WRITES = (
+    "base.pc", "base.sp", "base.sp_base", "base.depth", "base.init_depth",
+    "base.acct_used", "base.acct_code", "base.fr_create_slot",
+    "base.static", "base.cur_acct", "base.contract_id",
+    "base.caller_addr", "base.callvalue", "base.memory", "base.mem_words",
+    "base.calldata", "base.calldata_len", "base.returndata",
+    "base.returndata_len", "base.retval_len", "base.stack",
+    "base.st_keys", "base.st_vals", "base.st_used", "base.st_written",
+    "base.st_acct", "base.acct_bal", "base.warm_acct", "base.st_warm",
+    "base.gas_min", "base.gas_max", "base.gas_limit",
+    "base.halted", "base.reverted", "base.error", "base.err_code",
+    "stack_sym", "mem_sym", "mem_havoc", "retdata_sym", "rv_sym",
+    "rv_havoc", "cd_from_mem", "cd_havoc", "cd_sym", "callvalue_sym",
+    "caller_sym", "bal_epoch", "st_val_sym", "st_key_sym", "st_seq",
+    "sub_revert_pc", "sub_revert_cid",
+)
+
 
 def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
                   spec: SymSpec = SymSpec(),
@@ -2305,11 +2329,14 @@ def sym_superstep(sf: SymFrontier, env: Env, corpus: Corpus,
 
     f = ci.epilogue(sf.base, op, run, old_pc)
     sf = sf.replace(base=f)
-    # sub-frames that halted (or failed) this step return to their caller
+    # sub-frames that halted (or failed) this step return to their caller.
+    # Narrow cond: only pop_frames' declared writes cross the boundary —
+    # the fr_* snapshot stacks, tape, and constraint arrays bypass it, so
+    # the (rare) pop never forces a full-frontier carry copy.
     any_ended = jnp.any(sf.base.active & (sf.base.depth > 0)
                         & (sf.base.halted | sf.base.error))
-    return lax.cond(any_ended, lambda x: pop_frames(x, corpus),
-                    lambda x: x, sf)
+    return ci.narrow_cond(any_ended, lambda x: pop_frames(x, corpus),
+                          sf, _POP_FRAME_WRITES)
 
 
 def between_txs(sf: SymFrontier, require_mutation: bool = True,
@@ -2472,11 +2499,115 @@ def between_txs(sf: SymFrontier, require_mutation: bool = True,
     )
 
 
+def plan_fork_map(req2, free2, key, fork_policy: str = "fifo",
+                  fork_impl: str = "packed"):
+    """The fork source→destination mapping machinery, factored out of
+    :func:`expand_forks` so tools/scaling_report.py can trace and cost
+    it in isolation (the whole-frontier copy around it is linear in P
+    and drowns this term inside the full ``expand_forks`` jaxpr).
+
+    Inputs are block-shaped ``[G, B]``: the live request mask, the free
+    mask, and the policy key (ignored for fifo). Returns
+    ``(src2 [G, B], is_copy [P], slot [P])`` — per-destination source
+    index, the copy mask, and the per-source admission sentinel
+    (``slot == P`` ⇔ starved; intermediate values are only meaningful
+    on the legacy path where they are real slot ids).
+    """
+    G, B = req2.shape
+    P = G * B
+    loc = jnp.arange(B, dtype=I32)[None, :]
+    gidx = jnp.broadcast_to(jnp.arange(G, dtype=I32)[:, None], (G, B))
+    n_free = jnp.sum(free2.astype(I32), axis=1, keepdims=True)
+    if fork_policy == "fifo":
+        rank = jnp.cumsum(req2.astype(I32), axis=1) - req2.astype(I32)
+        order = None
+    elif fork_impl == "packed":
+        # pack (key, lane) into ONE int32 composite: composites are
+        # unique (the lane index breaks key ties exactly like the
+        # legacy stable argsort), so a single sort gives the
+        # admission order and a searchsorted over the sorted
+        # composites gives each lane's rank — no second argsort.
+        # The key budget shrinks when B is huge so the composite
+        # stays inside int32; policy keys are ≤ 16 bits by
+        # construction (weighted caps at 65535, random at 0x7FFF,
+        # depth at the constraint capacity), so KSENT only bites on
+        # absurd B — where a key collision merely falls back to
+        # lane-order tie-breaking, still a valid admission order.
+        KSENT = min(1 << 16, (2 ** 31 - 1 - (B - 1)) // B)
+        kcap = jnp.minimum(key, KSENT - 1)
+        ukey = jnp.where(req2, kcap, KSENT) * B + loc
+        skey = jnp.sort(ukey, axis=1)
+        order = (skey % B).astype(I32)
+        rank = jax.vmap(jnp.searchsorted)(skey, ukey).astype(I32)
+    else:
+        key = jnp.where(req2, key, 1 << 20)  # non-requesters sort last
+        order = jnp.argsort(key, axis=1, stable=True).astype(I32)
+        # rank = inverse permutation of order; argsort(order) IS that
+        # inverse, and sorts lower on TPU than a [G, B] scatter
+        rank = jnp.argsort(order, axis=1).astype(I32)
+    # beam: admit at most B//4 forks per block per superstep (shallowest
+    # first via the key above) — the frontier analog of a beam width
+    # (reference: beam.py ⚠unv); the rest defer/drop by mode
+    n_adm = (jnp.minimum(n_free, max(1, B // 4))
+             if fork_policy == "beam" else n_free)
+    if fork_impl == "packed":
+        # destination-major mapping (scatter-free, compare-free): the
+        # free slot with free-rank t receives the t-th admitted request
+        # — precisely the pairing the legacy source-major formulation
+        # produced via free_ids[rank] — so a cumsum over the free mask
+        # plus one gather of `order` replaces the [G, B] scatter (CPU
+        # legacy) / [G, B, B] one-hot compare (TPU legacy, the O(P²)
+        # superlinear term tools/scaling_report.py names).
+        if fork_policy == "fifo":
+            # requesters in lane order; B pads the tail (never gathered:
+            # free_rank < n_admit <= n_req keeps the index in-range)
+            order = jnp.sort(jnp.where(req2, loc, B), axis=1).astype(I32)
+        n_req = jnp.sum(req2.astype(I32), axis=1, keepdims=True)
+        n_admit = jnp.minimum(n_adm, n_req)
+        free_rank = jnp.cumsum(free2.astype(I32), axis=1) - free2.astype(I32)
+        is_copy2 = free2 & (free_rank < n_admit)
+        src_i = jnp.take_along_axis(
+            order, jnp.clip(free_rank, 0, B - 1), axis=1)
+        src2 = jnp.where(is_copy2, src_i, jnp.broadcast_to(loc, (G, B)))
+        is_copy = is_copy2.reshape(P)
+        # per-source admission bit (drop/defer accounting): admitted
+        # requests are exactly those ranked inside the admission window
+        slot = jnp.where(req2 & (rank < n_adm), 0, P).reshape(P)
+    elif fork_impl == "legacy":
+        free_ids = jnp.sort(jnp.where(free2, loc, B), axis=1)
+        slot2 = jnp.where(
+            req2 & (rank < n_adm),
+            jnp.take_along_axis(free_ids, jnp.clip(rank, 0, B - 1), axis=1),
+            B,
+        )  # local free-slot index per forking lane; B = dropped
+        if ci._use_scatter():
+            src2 = jnp.broadcast_to(loc, (G, B)).at[gidx, slot2].set(
+                jnp.broadcast_to(loc, (G, B)), mode="drop")
+            is_copy = jnp.zeros((G, B), dtype=bool).at[gidx, slot2].set(
+                True, mode="drop").reshape(P)
+        else:
+            # dense inverse-map: dst j is a copy iff some source i chose it
+            # (slot2 values are unique: distinct ranks -> distinct free ids),
+            # and its source is that i. [G, B, B] compare instead of scatter.
+            eq = slot2[:, :, None] == jnp.arange(B, dtype=I32)[None, None, :]
+            is_copy2 = jnp.any(eq, axis=1)
+            src_i = jnp.argmax(eq, axis=1).astype(I32)
+            src2 = jnp.where(is_copy2, src_i, jnp.broadcast_to(loc, (G, B)))
+            is_copy = is_copy2.reshape(P)
+        slot = jnp.where(slot2 < B,
+                         slot2 + jnp.arange(G, dtype=I32)[:, None] * B,
+                         P).reshape(P)
+    else:
+        raise ValueError(f"unknown fork_impl: {fork_impl}")
+    return src2, is_copy, slot
+
+
 def expand_forks(sf: SymFrontier, loop_bound: int = 0,
                  fork_block: int = 0,
                  fork_policy: str = "fifo",
                  defer_starved: bool = False,
-                 visited=None) -> SymFrontier:
+                 visited=None,
+                 fork_impl: str = "packed") -> SymFrontier:
     """Materialize fork requests: copy each forking lane into a free lane
     (prefix-sum compaction), point the copy at the jump target, and flip
     its final path-condition sign to "taken". Forks beyond capacity are
@@ -2508,6 +2639,23 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
     short): "fifo" admits by lane order, "shallow" prefers forks with the
     SHORTEST path condition (breadth-flavored), "deep" the longest
     (depth-flavored).
+
+    ``fork_impl`` selects the source→slot mapping machinery (the scaling
+    cliff's named term — docs/performance.md "Scaling cliff"):
+
+    - ``"packed"`` (default): scatter-free on EVERY backend. One sort of
+      a packed (key, lane) composite yields the admission order; the
+      per-lane admission rank comes from a searchsorted over the unique
+      composites (no argsort-of-argsort); and the destination map is
+      built destination-major — free slot j with free-rank t copies from
+      ``order[t]`` — a cumsum + gather instead of the legacy [G, B, B]
+      one-hot compare (O(P²) when fork_block=0) or [G, B] scatter.
+    - ``"legacy"``: the pre-restructure path (double argsort + backend-
+      adaptive scatter/dense inverse map), kept as the byte-parity
+      baseline (tests/test_superstep_parity.py) and for
+      tools/scaling_report.py to attribute the old curve.
+
+    Both produce identical frontiers for identical inputs.
     """
     P = sf.n_lanes
     if fork_block > 0 and P % fork_block != 0:
@@ -2518,17 +2666,14 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
         fork_block = P
     B = fork_block
     G = P // B
-    loc = jnp.arange(B, dtype=I32)[None, :]
-    gidx = jnp.broadcast_to(jnp.arange(G, dtype=I32)[:, None], (G, B))
     # a lane the feasibility sweep killed between its request and this
     # expansion must NOT be copied back to life (its con_len was already
     # unwound, so the sign-flip would land on an unrelated constraint)
     req_live = sf.fork_req & sf.base.active
     req2 = req_live.reshape(G, B)
     free2 = (~sf.base.active).reshape(G, B)
-    n_free = jnp.sum(free2.astype(I32), axis=1, keepdims=True)
     if fork_policy == "fifo":
-        rank = jnp.cumsum(req2.astype(I32), axis=1) - req2.astype(I32)
+        key = None
     else:
         depth = sf.con_len.reshape(G, B)
         C = sf.con_node.shape[1]
@@ -2567,38 +2712,8 @@ def expand_forks(sf: SymFrontier, loop_bound: int = 0,
                 key = seen.astype(I32).reshape(G, B)
         else:
             raise ValueError(f"unknown fork_policy: {fork_policy}")
-        key = jnp.where(req2, key, 1 << 20)  # non-requesting lanes sort last
-        order = jnp.argsort(key, axis=1, stable=True).astype(I32)
-        # rank = inverse permutation of order; argsort(order) IS that
-        # inverse, and sorts lower on TPU than a [G, B] scatter
-        rank = jnp.argsort(order, axis=1).astype(I32)
-    free_ids = jnp.sort(jnp.where(free2, loc, B), axis=1)
-    # beam: admit at most B//4 forks per block per superstep (shallowest
-    # first via the key above) — the frontier analog of a beam width
-    # (reference: beam.py ⚠unv); the rest defer/drop by mode
-    n_adm = (jnp.minimum(n_free, max(1, B // 4))
-             if fork_policy == "beam" else n_free)
-    slot2 = jnp.where(
-        req2 & (rank < n_adm),
-        jnp.take_along_axis(free_ids, jnp.clip(rank, 0, B - 1), axis=1),
-        B,
-    )  # local free-slot index per forking lane; B = dropped
-    if ci._use_scatter():
-        src2 = jnp.broadcast_to(loc, (G, B)).at[gidx, slot2].set(
-            jnp.broadcast_to(loc, (G, B)), mode="drop")
-        is_copy = jnp.zeros((G, B), dtype=bool).at[gidx, slot2].set(
-            True, mode="drop").reshape(P)
-    else:
-        # dense inverse-map: dst j is a copy iff some source i chose it
-        # (slot2 values are unique: distinct ranks -> distinct free ids),
-        # and its source is that i. [G, B, B] compare instead of scatter.
-        eq = slot2[:, :, None] == jnp.arange(B, dtype=I32)[None, None, :]
-        is_copy2 = jnp.any(eq, axis=1)
-        src_i = jnp.argmax(eq, axis=1).astype(I32)
-        src2 = jnp.where(is_copy2, src_i, jnp.broadcast_to(loc, (G, B)))
-        is_copy = is_copy2.reshape(P)
-    slot = jnp.where(slot2 < B, slot2 + jnp.arange(G, dtype=I32)[:, None] * B,
-                     P).reshape(P)
+    src2, is_copy, slot = plan_fork_map(req2, free2, key,
+                                        fork_policy, fork_impl)
     req = req_live
 
     # the iprof residual sidecar is lane-independent: detach it so the
@@ -2926,21 +3041,18 @@ def migrate_parked_device(sf: SymFrontier, fork_block: int,
                        fork_req=new.fork_req & ~vac)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("spec", "limits", "max_steps", "propagate_every",
-                              "fork_block", "track_coverage", "fork_policy",
-                              "defer_starved", "migrate_every")
-)
-def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
-            spec: SymSpec = SymSpec(),
-            limits: LimitsConfig = DEFAULT_LIMITS,
-            max_steps: int = 256,
-            propagate_every=None,
-            fork_block: int = 0,
-            track_coverage: bool = False,
-            fork_policy: str = "fifo",
-            defer_starved: bool = False,
-            migrate_every: int = 0):
+def _sym_run_impl(sf: SymFrontier, env: Env, corpus: Corpus,
+                  spec: SymSpec = SymSpec(),
+                  limits: LimitsConfig = DEFAULT_LIMITS,
+                  max_steps: int = 256,
+                  propagate_every=None,
+                  fork_block: int = 0,
+                  track_coverage: bool = False,
+                  fork_policy: str = "fifo",
+                  defer_starved: bool = False,
+                  migrate_every: int = 0,
+                  fork_impl: str = "packed",
+                  unroll: int = 1):
     """Run the symbolic engine until quiescence or max_steps supersteps.
     ``propagate_every`` > 0 interleaves feasibility sweeps that kill
     provably-unsat lanes (reference: lazy ``Solver.check()`` pruning);
@@ -2954,11 +3066,27 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
     ``fork_block``) runs the in-jit cross-block lane migration
     (``migrate_parked_device``) every that many supersteps — the ICI
     tier of SURVEY §5.8's rebalancing; the host-seam
-    ``rebalance_parked`` remains the chunk-boundary tier."""
+    ``rebalance_parked`` remains the chunk-boundary tier.
+    ``fork_impl`` selects :func:`expand_forks`' slot-mapping machinery
+    ("packed" scatter-free default / "legacy" parity baseline).
+    ``unroll`` > 1 rolls that many supersteps into ONE while-loop body
+    (Python-unrolled at trace time), amortizing the loop's per-iteration
+    carry handling over K steps. Byte-parity with unroll=1 is preserved:
+    the quiescence check runs every K steps instead of every step, but a
+    quiesced frontier's supersteps are exact no-ops (every write is
+    masked by ``running``), and the cadence-gated passes (propagation
+    sweep, migration) gain an explicit any-running gate so a tail step
+    after mid-block quiescence cannot fire them where the per-step loop
+    would have exited. Cadences stay anchored to the absolute step index.
+    ``unroll`` values not dividing ``max_steps`` are lowered to the
+    largest divisor so the loop cannot overshoot the step budget."""
     from .propagate import kill_infeasible
 
     if propagate_every is None:
         propagate_every = limits.propagate_every
+    unroll = max(1, int(unroll))
+    while unroll > 1 and max_steps % unroll:
+        unroll -= 1
 
     P_run = sf.n_lanes
     C, MC = corpus.code.shape
@@ -2968,8 +3096,15 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
         i, s, _ = state
         return (i < max_steps) & jnp.any(s.base.running)
 
-    def body(state):
-        i, s, visited = state
+    def one_step(i, s, visited):
+        if unroll > 1:
+            # the per-step loop re-checks its cond BEFORE each body: a
+            # step that begins quiesced never runs — including its
+            # cadence passes. Unrolled tail steps replicate that exact
+            # gate with the ENTRY state (post-superstep running would
+            # over-suppress: a sweep whose step started live runs in the
+            # per-step path even when that step quiesced the frontier)
+            alive = jnp.any(s.base.running)
         if track_coverage:
             # init-frame pcs index the per-lane init buffer, not the
             # contract image — they must not pollute its bitmap
@@ -2981,17 +3116,24 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
         # expand_forks tree-gathers EVERY leaf of the frontier; gate it so
         # supersteps with no pending fork request (the common case) skip
         # that full-frontier pass. Identity-valued when no live request.
+        pred = jnp.any(s.fork_req & s.base.active)
+        if unroll > 1:
+            pred = pred & alive
         s = lax.cond(
-            jnp.any(s.fork_req & s.base.active),
+            pred,
             lambda x: expand_forks(x, limits.loop_bound, fork_block,
                                    fork_policy, defer_starved,
-                                   visited if track_coverage else None),
+                                   visited if track_coverage else None,
+                                   fork_impl),
             lambda x: x,
             s,
         )
         if propagate_every:
+            gate = (i % propagate_every) == propagate_every - 1
+            if unroll > 1:
+                gate = gate & alive
             s = ci.narrow_cond(
-                (i % propagate_every) == propagate_every - 1,
+                gate,
                 kill_infeasible, s,
                 ("iv_lo", "iv_hi", "kb_m", "kb_v", "prop_len",
                  "base.active", "fork_req", "killed_infeasible",
@@ -3010,16 +3152,40 @@ def sym_run(sf: SymFrontier, env: Env, corpus: Corpus,
             # pay the full-leaf no-op migration pass every firing
             need = (jnp.any(jnp.any(stm, axis=1) & (occ == Bm))
                     & jnp.any(occ <= Bm - 2))
+            if unroll > 1:
+                need = need & alive
             s = lax.cond(
                 ((i % migrate_every) == migrate_every - 1) & need,
                 lambda x: migrate_parked_device(x, fork_block),
                 lambda x: x,
                 s,
             )
-        return i + 1, s, visited
+        return s, visited
+
+    def body(state):
+        i, s, visited = state
+        for k in range(unroll):
+            s, visited = one_step(i + k, s, visited)
+        return i + unroll, s, visited
 
     _, sf, visited = lax.while_loop(cond, body, (jnp.int32(0), sf, visited0))
     return (sf, visited) if track_coverage else sf
+
+
+_SYM_RUN_STATIC = ("spec", "limits", "max_steps", "propagate_every",
+                   "fork_block", "track_coverage", "fork_policy",
+                   "defer_starved", "migrate_every", "fork_impl", "unroll")
+
+sym_run = jax.jit(_sym_run_impl, static_argnames=_SYM_RUN_STATIC)
+
+# Donating entry for callers that consume their input frontier (the
+# analysis chunk loop rebinds ``sf`` on every call): XLA aliases the
+# input buffers into the outputs, so the superstep loop's carry never
+# holds two copies of a multi-GiB frontier. Never use this where the
+# input ``sf`` is reused afterwards (bench reps, parity tests). CPU
+# ignores donation — callers gate on backend to avoid warning spam.
+sym_run_donated = jax.jit(_sym_run_impl, static_argnames=_SYM_RUN_STATIC,
+                          donate_argnums=(0,))
 
 
 # Resolve the host-callback capability now, at import — OUTSIDE any jax

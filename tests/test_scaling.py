@@ -1,0 +1,52 @@
+"""Compiled-cost scaling smoke (tools/scaling_report.py).
+
+Holds the engine to its committed growth budget WITHOUT hardware: the
+attribution traces jaxprs (no execution), so a CPU-only CI round still
+catches a PR that reintroduces an O(P·x) term into the superstep body —
+the class of regression behind the 4096→16384 throughput cliff. Small
+P values keep the traces tier-1 fast; exponents are shape-derived, so
+they are exactly what the 16k-lane trace would fit.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import scaling_report  # noqa: E402  (tools/ is not a package)
+
+P_SMOKE = (256, 1024)
+
+
+def test_superstep_body_growth_within_budget():
+    # the committed threshold (≈1.05 total ⇔ ≈0.05 per-lane): the whole
+    # while-loop body — superstep, expand gate, pop seam, carry — must
+    # cost O(P^1.05) or less with the packed fork map
+    rep = scaling_report.attribution(P_SMOKE, fork_impl="packed",
+                                     only=("sym_run_body",))
+    e = rep["superstep_body_exponent"]
+    assert e is not None
+    assert e <= scaling_report.PER_LANE_EXPONENT_BUDGET, (
+        f"superstep body op growth fit P^{e}: a superlinear term is back "
+        f"(budget {scaling_report.PER_LANE_EXPONENT_BUDGET}; run "
+        f"tools/scaling_report.py to name the bucket)")
+    assert rep["dominant_superlinear"] is None
+
+
+def test_attribution_names_legacy_dense_term():
+    # the report must still SEE the old cliff: the legacy dense fork map
+    # ([G, B, B] one-hot) fits ~P² and is named as dominant
+    rep = scaling_report.attribution(P_SMOKE, fork_impl="legacy",
+                                     only=("fork_plan",))
+    b = rep["buckets"]["fork_plan"]
+    assert b["exponent"] > 1.5, (
+        f"legacy dense fork map fit P^{b['exponent']}; the attribution "
+        "lost sight of the [G,B,B] term it exists to name")
+    assert rep["dominant_superlinear"] == "fork_plan"
+
+
+def test_packed_fork_plan_is_linear():
+    rep = scaling_report.attribution(P_SMOKE, fork_impl="packed",
+                                     only=("fork_plan",))
+    b = rep["buckets"]["fork_plan"]
+    assert b["exponent"] <= 1.05, (
+        f"packed fork map fit P^{b['exponent']}, expected linear")

@@ -1,0 +1,121 @@
+"""Byte-parity for the superstep restructure (ROADMAP scaling cliff).
+
+The packed fork map (scatter-free rank/sort in ``expand_forks``), the
+narrowed pop_frames cond boundary, and the unrolled while-loop body are
+PERFORMANCE restructures: every one of them must leave the analysis
+OUTPUT bit-identical to the legacy per-step path, or a future perf PR
+could trade correctness for throughput without any test noticing.
+
+Tier-1 runs the full pipeline (SymExecWrapper → fire_lasers) over the
+synthetic soak mix twice — legacy/per-step vs packed/unrolled — and
+requires identical issue rows, identical surviving paths, and identical
+iprof rows. The per-fork-policy engine-level matrix is ``slow`` (each
+(policy, impl, unroll) combination is a fresh XLA compile of the whole
+engine — minutes of compile for seconds of run).
+"""
+
+import os
+import sys
+
+import numpy as np
+import jax
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import gen_corpus  # noqa: E402  (tools/ is not a package)
+
+from mythril_tpu.analysis import SymExecWrapper, fire_lasers  # noqa: E402
+from mythril_tpu.config import DEFAULT_LIMITS  # noqa: E402
+from mythril_tpu.core import Corpus, make_env  # noqa: E402
+from mythril_tpu.disassembler import ContractImage  # noqa: E402
+from mythril_tpu.disassembler.asm import erc20_like  # noqa: E402
+from mythril_tpu.symbolic import SymSpec, make_sym_frontier  # noqa: E402
+from mythril_tpu.symbolic.engine import sym_run  # noqa: E402
+
+L = DEFAULT_LIMITS
+
+# a vulnerable/safe pair per class keeps the run cheap while still
+# exercising forks, storage, reverts and the issue pipeline
+_SOAK_N = 4
+
+
+def _soak_codes():
+    return [gen_corpus.MIX[k % len(gen_corpus.MIX)](k)
+            for k in range(_SOAK_N)]
+
+
+def _pipeline(fork_impl, unroll):
+    sym = SymExecWrapper(_soak_codes(), lanes_per_contract=4,
+                         max_steps=48, transaction_count=1,
+                         enable_iprof=True,
+                         fork_impl=fork_impl, unroll=unroll)
+    report = fire_lasers(sym)
+    return sym, report
+
+
+def _tree_mismatches(a, b):
+    la, _ = jax.tree_util.tree_flatten_with_path(a)
+    lb, _ = jax.tree_util.tree_flatten_with_path(b)
+    assert len(la) == len(lb)
+    bad = []
+    for (pa, xa), (_, xb) in zip(la, lb):
+        if xa is None and xb is None:
+            continue
+        if not np.array_equal(np.asarray(xa), np.asarray(xb)):
+            bad.append(jax.tree_util.keystr(pa))
+    return bad
+
+
+def _assert_pipeline_parity(sym_a, rep_a, sym_b, rep_b):
+
+    issues_a = [i.as_dict() for i in rep_a.sorted()]
+    issues_b = [i.as_dict() for i in rep_b.sorted()]
+    assert issues_a == issues_b, (
+        "issue rows diverged between legacy/per-step and packed/unrolled")
+
+    # surviving paths: same frontier, lane for lane
+    bad = _tree_mismatches(sym_a.sf, sym_b.sf)
+    assert not bad, f"final frontier diverged on leaves: {bad[:8]}"
+    assert sym_a.coverage == sym_b.coverage
+
+    # iprof rows: identical opcode -> count table
+    assert sym_a.iprof == sym_b.iprof
+
+
+def test_pipeline_parity_packed_unrolled_vs_legacy():
+    # unroll=2 keeps the XLA compile of the unrolled body inside the
+    # tier-1 wall; the deeper unroll=4 body is covered by the slow test
+    sym_a, rep_a = _pipeline("legacy", 1)
+    sym_b, rep_b = _pipeline("packed", 2)
+    _assert_pipeline_parity(sym_a, rep_a, sym_b, rep_b)
+
+
+@pytest.mark.slow
+def test_pipeline_parity_deep_unroll():
+    sym_a, rep_a = _pipeline("legacy", 1)
+    sym_b, rep_b = _pipeline("packed", 4)
+    _assert_pipeline_parity(sym_a, rep_a, sym_b, rep_b)
+
+
+def _run_engine(policy, impl, unroll, defer=True, cov=False):
+    P = 32
+    img = ContractImage.from_bytecode(erc20_like(), L.max_code)
+    corpus = Corpus.from_images([img])
+    active = np.zeros(P, dtype=bool)
+    active[: P // 4] = True
+    sf = make_sym_frontier(P, L, active=active)
+    env = make_env(P)
+    return sym_run(sf, env, corpus, SymSpec(), L, max_steps=24,
+                   fork_policy=policy, defer_starved=defer,
+                   track_coverage=cov, fork_impl=impl, unroll=unroll)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["fifo", "shallow", "deep", "weighted",
+                                    "random", "beam", "coverage"])
+def test_sym_run_parity_per_policy(policy):
+    cov = policy == "coverage"
+    a = _run_engine(policy, "legacy", 1, cov=cov)
+    b = _run_engine(policy, "packed", 2, cov=cov)
+    bad = _tree_mismatches(a, b)
+    assert not bad, f"{policy}: frontier diverged on leaves: {bad[:8]}"

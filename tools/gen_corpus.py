@@ -22,8 +22,11 @@ import sys
 
 # host-side tool: never let the imports below (asm → package __init__ →
 # u256 device tables) initialize a TPU backend — under a wedged axon
-# tunnel that hangs the process before the first file is written
-os.environ["JAX_PLATFORMS"] = "cpu"
+# tunnel that hangs the process before the first file is written. Only
+# when run AS the tool: bench.py imports MIX for the BENCH_E2E corpus
+# and must keep its own backend choice.
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
