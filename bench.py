@@ -29,7 +29,11 @@ Modes (each keeps the one-record-per-line contract):
     skipped first under budget pressure, as recorded skips);
   - ``BENCH_SCALING=1``: compiled-cost attribution (tools/
     scaling_report.py) — fitted per-phase growth exponents from jaxpr
-    traces, no execution, hardware-independent.
+    traces, no execution, hardware-independent;
+  - ``BENCH_COLDSTART=1``: serve-daemon time-to-first-verdict, cold
+    (fresh data dir, empty compile store) vs restarted on the same
+    data dir with the registry prewarm replayed (docs/serving.md
+    "Compile artifacts & prewarm").
 """
 
 from __future__ import annotations
@@ -371,6 +375,125 @@ def _run_sweep_per_tier(tiers, remaining) -> None:
                   flush=True)
 
 
+# --- cold-start benchmark (docs/serving.md "Compile artifacts & ---------
+# --- prewarm") ----------------------------------------------------------
+
+def _coldstart_phase(mode: str) -> None:
+    """One ``BENCH_COLDSTART`` daemon generation, run in its own
+    process so XLA's in-process jit cache can't leak between the cold
+    and the prewarmed measurement. Starts an AnalysisDaemon on the
+    shared ``BENCH_COLDSTART_DIR`` (compile store on by default),
+    waits for the background prewarm pass to settle, submits ONE
+    fresh contract and times the first verdict. Prints a
+    ``COLDSTART {json}`` marker line for the orchestrator — not a
+    bench record."""
+    import time
+
+    data_dir = os.environ["BENCH_COLDSTART_DIR"]
+    t_boot = time.monotonic()
+    from mythril_tpu.disassembler.asm import assemble
+    from mythril_tpu.obs import metrics as obs_metrics
+    from mythril_tpu.serve import AnalysisDaemon, ServeOptions
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import serve_client
+
+    opts = ServeOptions(batch_size=2, lanes_per_contract=8,
+                        max_steps=64, transaction_count=1,
+                        modules=["AccidentallyKillable"],
+                        limits_profile="test")
+    dm = AnalysisDaemon(opts, data_dir=data_dir, port=0)
+    dm.start()
+    url = f"http://127.0.0.1:{dm.port}"
+    doc = {"phase": mode, "ok": False}
+    try:
+        # let the prewarm pass settle before measuring (the cold
+        # generation has no buckets and settles immediately)
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            pd = dm.health().get("prewarm") or {}
+            if pd.get("state") in ("done", "failed", "disabled"):
+                break
+            time.sleep(0.25)
+        doc["prewarm"] = dm.health().get("prewarm")
+        compiles0 = obs_metrics.REGISTRY.counter(
+            "engine_compiles_total").value
+        # distinct bytecode per generation — the dedupe store must not
+        # short-circuit the prewarmed generation's measurement
+        code = assemble({"cold": 0, "warm": 2}.get(mode, 4),
+                        "SELFDESTRUCT")
+        t0 = time.monotonic()
+        out = serve_client.get_result(
+            url, serve_client.submit(url, [("c", code)])["id"],
+            wait=300.0)
+        doc.update(
+            ok=(out.get("state") == "done"),
+            first_verdict_sec=round(time.monotonic() - t0, 3),
+            startup_sec=round(t0 - t_boot, 3),
+            engine_compiles=obs_metrics.REGISTRY.counter(
+                "engine_compiles_total").value - compiles0,
+            warm_hits=obs_metrics.REGISTRY.counter(
+                "serve_warm_compile_hits_total").value)
+    finally:
+        dm.shutdown("bench-coldstart")
+    print("COLDSTART " + json.dumps(doc), flush=True)
+
+
+def bench_coldstart(remaining) -> None:
+    """``BENCH_COLDSTART=1``: time-to-first-verdict for a COLD serve
+    daemon vs a RESTARTED one on the same data dir whose registry
+    prewarm replayed the hot shape buckets. Each generation is a
+    subprocess (XLA's in-process jit cache would otherwise make the
+    'restart' trivially warm); emits one record with both walls and
+    the speedup."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="bench_coldstart_")
+    phases = {}
+    try:
+        for mode in ("cold", "warm"):
+            if remaining() < 60:
+                phases[mode] = {"error": "budget: %.0fs left"
+                                         % remaining()}
+                break
+            env = dict(os.environ)
+            env.pop("BENCH_COLDSTART", None)
+            env.update(BENCH_COLDSTART_PHASE=mode,
+                       BENCH_COLDSTART_DIR=os.path.join(work, "sd"),
+                       MYTHRIL_BENCH_NO_PROBE="1")
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    capture_output=True, text=True,
+                    timeout=max(60.0, remaining() - 10.0), env=env)
+                line = next((ln for ln in r.stdout.splitlines()
+                             if ln.startswith("COLDSTART ")), None)
+                if line:
+                    phases[mode] = json.loads(line[len("COLDSTART "):])
+                else:
+                    phases[mode] = {
+                        "error": "no marker (rc=%s): %s"
+                                 % (r.returncode,
+                                    (r.stderr or r.stdout)[-300:])}
+            except Exception as e:  # one failed generation: still emit
+                phases[mode] = {"error": repr(e)[:300]}
+        cold, warm = phases.get("cold") or {}, phases.get("warm") or {}
+        rec = {"metric": "coldstart_first_verdict_sec",
+               "value": warm.get("first_verdict_sec", 0.0),
+               "unit": "s (registry-prewarmed restart)",
+               "extra": {"cold": cold, "warm": warm}}
+        if cold.get("first_verdict_sec") and warm.get("first_verdict_sec"):
+            rec["extra"]["speedup_vs_cold"] = round(
+                cold["first_verdict_sec"]
+                / max(1e-9, warm["first_verdict_sec"]), 2)
+        print(json.dumps(rec), flush=True)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_profile(timeout_s: float = 600.0) -> dict:
     """Superstep time breakdown (VERDICT r3 ask #1b): per-variant dispatch
     cost + bandwidth floor, via tools/profile_superstep.py in a subprocess
@@ -567,6 +690,26 @@ def main():
 
     def remaining() -> float:
         return budget - sw.elapsed
+
+    if os.environ.get("BENCH_COLDSTART_PHASE"):
+        # one subprocess generation of the BENCH_COLDSTART mode below —
+        # prints a COLDSTART marker line, never a bench record
+        try:
+            _coldstart_phase(os.environ["BENCH_COLDSTART_PHASE"])
+        except Exception as e:
+            print("COLDSTART " + json.dumps(
+                {"phase": os.environ["BENCH_COLDSTART_PHASE"],
+                 "ok": False, "error": repr(e)[:300]}), flush=True)
+        sw.stop()
+        with _EMIT_LOCK:
+            _EMITTED = True
+        return
+    if os.environ.get("BENCH_COLDSTART"):
+        bench_coldstart(remaining)
+        sw.stop()
+        with _EMIT_LOCK:
+            _EMITTED = True
+        return
 
     if not os.environ.get("MYTHRIL_BENCH_NO_PROBE"):
         ok, diag = _probe_backend()

@@ -62,7 +62,7 @@ import signal
 import struct
 import sys
 import time
-from typing import BinaryIO, Dict, Optional
+from typing import BinaryIO, Dict, List, Optional
 
 from .obs import metrics as obs_metrics
 from .obs import trace as obs_trace
@@ -169,6 +169,91 @@ def _rss_bytes() -> int:
         return 0
 
 
+#: marker a supervisor drops into the shared XLA cache dir when a
+#: worker dies uncleanly mid-batch: the NEXT spawn must probe the cache
+#: before trusting it (a killed writer can leave a torn entry that
+#: segfaults later readers — tests/conftest.py documents the original
+#: incident)
+CACHE_DIRTY_MARKER = ".dirty"
+
+# the probe body: a minimal jit through the suspect cache dir, run in
+# a THROWAWAY subprocess (PR-13 pattern: a poisoned cache segfaults the
+# probe child, never this worker). MYTHRIL_CACHE_PROBE_FAULT=segv|hang
+# is the deterministic-chaos hook standing in for a real torn entry.
+_PROBE_SRC = """\
+import os, signal, sys, time
+f = os.environ.get("MYTHRIL_CACHE_PROBE_FAULT")
+if f == "segv":
+    os.kill(os.getpid(), signal.SIGSEGV); time.sleep(5)
+if f == "hang":
+    time.sleep(3600)
+import jax
+jax.config.update("jax_compilation_cache_dir", sys.argv[1])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+import jax.numpy as jnp
+jax.jit(lambda x: x + 1)(jnp.zeros((8,), jnp.int32)).block_until_ready()
+"""
+
+
+def probe_cache(cache: str, timeout: Optional[float] = None) -> bool:
+    """Whether a probe compile through ``cache`` survives. Best-effort
+    by construction (a torn entry only fires when ITS key is read; the
+    probe catches index/deserializer-level poison), but the failure
+    mode is contained: the probe child dies, not the engine."""
+    import subprocess
+
+    if timeout is None:
+        timeout = float(os.environ.get(
+            "MYTHRIL_CACHE_PROBE_TIMEOUT", "180"))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC, cache],
+                           capture_output=True, timeout=timeout,
+                           env=env)
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _maybe_probe_cache(cache: str) -> str:
+    """Corrupt-persistent-cache resilience: when the supervisor flagged
+    the cache ``.dirty`` (a worker died uncleanly) or the operator
+    forces it (``MYTHRIL_CACHE_PROBE=1``), probe-compile in a subprocess
+    before the engine touches a single entry. A failed probe sets the
+    WHOLE dir aside as ``<cache>.corrupt`` (evidence preserved — never
+    a silent wipe) and continues cold on a fresh dir with a loud
+    ``compile_cache_quarantined`` event; a clean probe clears the
+    marker. Returns the cache dir the engine should use."""
+    marker = os.path.join(cache, CACHE_DIRTY_MARKER)
+    forced = os.environ.get("MYTHRIL_CACHE_PROBE") == "1"
+    if not (forced or os.path.exists(marker)):
+        return cache
+    if probe_cache(cache):
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
+        return cache
+    dest = cache + ".corrupt"
+    if os.path.exists(dest):
+        dest = f"{cache}.corrupt.{os.getpid()}"
+    try:
+        os.replace(cache, dest)
+    except OSError:
+        dest = None  # couldn't set aside; still never serve it as-is
+    os.makedirs(cache, exist_ok=True)
+    obs_trace.event("compile_cache_quarantined", cache=cache,
+                    quarantined_to=dest or "")
+    obs_metrics.REGISTRY.counter(
+        "compile_cache_quarantined_total",
+        help="poisoned XLA cache dirs set aside .corrupt").inc()
+    print(f"[worker] XLA cache {cache} failed its probe compile; "
+          f"quarantined to {dest}, continuing cold", file=sys.stderr,
+          flush=True)
+    return cache
+
+
 def _build_campaign(config: Dict):
     """The worker's resident engine: a corpus-less CorpusCampaign with
     the parent's knobs. Heavy imports happen here, under the parent's
@@ -178,6 +263,7 @@ def _build_campaign(config: Dict):
 
     cache = os.environ.get("MYTHRIL_WORKER_JAX_CACHE")
     if cache:
+        cache = _maybe_probe_cache(cache)
         import jax
 
         jax.config.update("jax_compilation_cache_dir", cache)
@@ -251,7 +337,61 @@ def _run_batch(camp, stub: bool, msg: Dict,
             with obs_trace.timer("host_phase", bi=bi) as hp:
                 out = camp._harvest_batch(bi, sym)
         out["phases"] = {"device": dv.dur or 0.0, "host": hp.dur or 0.0}
+        # the chunk step-counts this worker has compiled through the
+        # shared persistent cache: the parent folds them into its
+        # compile-store bucket so a RESTARTED daemon's prewarm can seed
+        # them and keep engine_compiles_total flat across the restart
+        out["warm_chunks"] = sorted(
+            {int(c) for c in camp._warm_set(lanes, width)
+             if not isinstance(c, tuple)})
         return out
+
+
+def _run_prewarm(camp, stub: bool, msg: Dict) -> Dict:
+    """AOT prewarm verb: compile a list of shape buckets ahead of
+    traffic. Each bucket is a shape SKELETON — ``{lanes, width,
+    tier?}`` — compiled by running ``_explore_batch`` over an all-pad
+    STOP-stub corpus (shape, not content, keys the jaxpr: the
+    ShapeDtypeStruct idea from tools/scaling_report.py without needing
+    AOT export plumbing; the persistent cache makes the artifact
+    durable). One bucket per frame-roundtrip would be cleaner but
+    slower; instead the whole list rides one verb and the reply carries
+    how far it got. Stub mode validates shapes and counts — the
+    supervision-machinery tests' fast path."""
+    buckets = list(msg.get("buckets") or [])
+    done = 0
+    warm_chunks: List[List[int]] = []
+    for b in buckets:
+        lanes = int(b.get("lanes") or 0)
+        width = int(b.get("width") or 0)
+        if lanes <= 0 or width <= 0:
+            raise ValueError(
+                f"prewarm bucket {b!r}: non-positive shape")
+        if stub:
+            done += 1
+            warm_chunks.append([])
+            continue
+        # the bucket's recorded chunks are warm FLEET-wide (their
+        # executables live in the shared persistent cache), so mark
+        # them before exploring: the compile counter must read this
+        # pass as cache traffic, not fresh compilation
+        camp._warm_set(lanes, width).update(
+            int(c) for c in b.get("chunks") or ())
+        tier = b.get("tier") or msg.get("on_tier")
+        cm = camp._tier_device(tier) if tier else None
+        with (cm if cm is not None else contextlib.nullcontext()):
+            with obs_trace.timer("prewarm_compile", lanes=lanes,
+                                 width=width, tier=tier or ""):
+                sym = camp._explore_batch(-1, [], [], lanes, width)
+                # the wrapper compiles lazily as chunks run; touching
+                # the exploration result forces every chunk through
+                camp._harvest_batch(-1, sym)
+        warm_chunks.append(sorted(
+            {int(c) for c in camp._warm_set(lanes, width)
+             if not isinstance(c, tuple)}))
+        done += 1
+    return {"done": done, "total": len(buckets), "stub": stub,
+            "warm_chunks": warm_chunks}
 
 
 def _drain_telemetry(msnap: Optional[Dict]) -> Optional[Dict]:
@@ -317,6 +457,13 @@ def worker_main() -> int:
                 reply = {"ok": True, "value": value}
                 tear = (fault is not None
                         and fault.should("mid-reply", nbatch))
+            elif op == "prewarm":
+                value = _run_prewarm(camp, stub, msg)
+                tel = _drain_telemetry(msnap)
+                if tel is not None:
+                    msnap = tel.pop("_after")
+                    value["telemetry"] = tel
+                reply = {"ok": True, "value": value}
             elif op == "exit":
                 try:
                     out.write(pack_frame({"ok": True, "value": None}))

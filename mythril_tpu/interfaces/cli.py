@@ -511,6 +511,24 @@ def create_parser() -> argparse.ArgumentParser:
                          "its worker holds; demotions/re-promotions "
                          "surface in /healthz backend_tiers and the "
                          "engine_tier_* metrics (docs/serving.md)")
+    sv.add_argument("--compile-store", metavar="DIR", default=None,
+                    help="fleet compile-artifact store: durable "
+                         "shape-bucket registry + shared persistent "
+                         "XLA cache, so restarted/sibling replicas and "
+                         "re-promoted tiers come back warm (default: "
+                         "<data-dir>/compile_store; docs/serving.md "
+                         "'Compile artifacts & prewarm')")
+    sv.add_argument("--prewarm", dest="prewarm", action="store_true",
+                    default=True,
+                    help="AOT-prewarm the registry's hottest shape "
+                         "buckets on daemon start, worker respawn, and "
+                         "tier re-promotion (default: on; strictly "
+                         "subordinate to live traffic)")
+    sv.add_argument("--no-prewarm", dest="prewarm", action="store_false",
+                    help="disable the background prewarm pass (the "
+                         "compile store still records warm shapes and "
+                         "the shared XLA cache still serves lazy "
+                         "compiles)")
     sv.add_argument("--trace", metavar="FILE",
                     help="Chrome-trace + JSONL event log (admit/"
                          "queue_wait/schedule/stream spans ride the "
@@ -1044,7 +1062,9 @@ def exec_serve(args) -> int:
         backfill_uri=args.backfill,
         backfill_window=args.backfill_window,
         compact_every=args.compact_every,
-        store_only=args.store_only)
+        store_only=args.store_only,
+        compile_store=(args.compile_store or "auto"),
+        prewarm=args.prewarm)
     daemon.install_signal_handlers()
     try:
         daemon.start()
@@ -1094,8 +1114,16 @@ def _serve_heartbeat(daemon, period: float) -> None:
             if rh.count:
                 p50, p95 = rh.quantile(0.5), rh.quantile(0.95)
                 rq = f" | req p50 {p50:.2f}s/p95 {p95:.2f}s"
+            # compile-warmth token (docs/serving.md "Compile artifacts
+            # & prewarm"): shape classes warm in-process / registry
+            # buckets for the active tier
+            wa = ""
+            warm_a, warm_b = daemon.scheduler.warm_counts()
+            if warm_a or warm_b:
+                wa = f" warm {warm_a}/" + ("-" if warm_b is None
+                                           else str(warm_b))
             print(f"[serve] depth {daemon.queue.depth()} "
-                  f"store {daemon.store.count()}{rq}",
+                  f"store {daemon.store.count()}{wa}{rq}",
                   file=sys.stderr, flush=True)
 
     threading.Thread(target=_loop, daemon=True,

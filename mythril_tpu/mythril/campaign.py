@@ -46,6 +46,7 @@ CLI: ``python -m mythril_tpu analyze --corpus DIR`` (see interfaces/cli).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import sys
@@ -367,6 +368,18 @@ class CorpusCampaign:
         # compile counter / cold spans / pacing stop re-counting it
         self._warm_shapes: Dict[tuple, set] = {}
         self._extern_batches = 0
+        # fleet-wide compile-artifact store (mythril_tpu/compilestore.py,
+        # docs/serving.md "Compile artifacts & prewarm"): attached by
+        # the serve scheduler / daemon via attach_compile_store(); when
+        # present, every warm observation is also recorded durably and
+        # prewarm_from_store() can bring a fresh process back warm.
+        # _prewarm_pending flags recovery events (tier re-promotion,
+        # worker respawn) for the daemon's background prewarm thread.
+        self._compile_store = None
+        self._store_cfh: Optional[str] = None
+        self._prewarm_pending = False
+        self._prewarm_state: Dict = {"state": "idle", "done": 0,
+                                     "total": 0, "last_error": None}
         # portfolio-stats baseline for this run's deltas (heartbeat
         # Z3-avoided %, per-batch solver_portfolio events, the report)
         self._pstats0: Optional[Dict] = None
@@ -626,6 +639,186 @@ class CorpusCampaign:
         warm-compile-hit predicate (docs/serving.md)."""
         return bool(self._warm_shapes.get(self._shape_key(lanes, width)))
 
+    # --- fleet compile-artifact store (docs/serving.md "Compile
+    # --- artifacts & prewarm") ------------------------------------------
+    def attach_compile_store(self, store, cfh: Optional[str] = None) -> None:
+        """Wire a :class:`~mythril_tpu.compilestore.CompileStore` into
+        this campaign: warm observations are recorded durably per
+        ``(tier, shape-class, semantic-config-hash)`` bucket, and
+        :meth:`prewarm_from_store` can replay the registry to bring a
+        fresh process back warm. ``cfh`` defaults to
+        :meth:`semantic_hash` (serve passes its own config hash so the
+        bucket key space matches the request dedupe key space)."""
+        self._compile_store = store
+        self._store_cfh = cfh or self.semantic_hash()
+
+    def semantic_hash(self) -> str:
+        """Semantic-config hash of this campaign's compiled behavior:
+        the worker config minus purely operational knobs, so two
+        processes with the same engine semantics land in the same
+        compile-store buckets."""
+        from ..compilestore import semantic_config_hash
+
+        cfg = self._worker_config()
+        for k in ("solver_store", "solver_workers", "parallel_solving"):
+            cfg.pop(k, None)
+        # a spec/plugin object's repr embeds its address — hash the
+        # TYPE, which is what actually forks the compiled engine
+        cfg["spec"] = (type(self.spec).__name__
+                       if self.spec is not None else None)
+        return semantic_config_hash(cfg)
+
+    def _active_tier(self) -> str:
+        """The tier label compile-store buckets are keyed under: the
+        ladder's current tier when one exists, else the process's
+        default jax backend (what an unladdered campaign compiles on)."""
+        if self._tm is not None:
+            return self._tm.current
+        try:
+            import jax
+
+            return jax.default_backend()
+        except Exception:  # noqa: BLE001 — no backend at all
+            return "cpu"
+
+    def _store_record(self, lanes: Optional[int] = None,
+                      width: Optional[int] = None) -> None:
+        """Durably record one warm observation (hit count + the chunk
+        step-counts now warm) for this shape class. Never raises — a
+        full disk or torn registry must not fail the batch that just
+        succeeded."""
+        store = self._compile_store
+        if store is None:
+            return
+        try:
+            chunks = [c for c in self._warm_set(lanes, width)
+                      if isinstance(c, int)]
+            store.record(self._active_tier(),
+                         self._shape_key(lanes, width),
+                         self._store_cfh or self.semantic_hash(),
+                         chunks=chunks)
+        except Exception as e:  # noqa: BLE001 — recording is best-effort
+            log.warning("compile-store record failed: %s", e)
+
+    def warm_counts(self) -> tuple:
+        """``(warm shape classes in this process, registry buckets for
+        the active tier)`` — the heartbeat's ``warm a/b`` token.
+        The second element is ``None`` without an attached store."""
+        a = sum(1 for s in self._warm_shapes.values() if s)
+        if self._compile_store is None:
+            return a, None
+        try:
+            b = len(self._compile_store.buckets(
+                tier=self._active_tier(), cfh=self._store_cfh))
+        except Exception:  # noqa: BLE001 — registry scan is best-effort
+            b = 0
+        return a, b
+
+    def prewarm_bucket(self, bucket: Dict) -> None:
+        """AOT-prewarm one registry bucket: seed the warm-shape set
+        with the bucket's recorded chunk step-counts (they are warm
+        FLEET-wide — the shared persistent cache holds their
+        executables, so compiling them again is a cache hit, and the
+        compile counter must not re-count it), then drive the compile —
+        through the supervised worker when isolation is on, in-process
+        otherwise. A stub batch-runner has no engine to warm: seeding
+        is the whole effect. Buckets from another engine shape config
+        (different max_steps / tx count) are skipped — their compiled
+        functions could never be replayed here."""
+        shape = [int(d) for d in bucket.get("shape") or ()]
+        if len(shape) != 4:
+            raise ValueError(f"prewarm bucket shape {shape!r}")
+        width, lanes, max_steps, txc = shape
+        if max_steps != self.max_steps or txc != self.transaction_count:
+            return
+        chunks = [int(c) for c in bucket.get("chunks") or ()]
+        self._warm_set(lanes, width).update(chunks)
+        tier = self._tm.current if self._tm is not None else None
+        if self._worker_enabled():
+            sup = self._ensure_supervisor()
+            val = sup.prewarm([{"lanes": lanes, "width": width,
+                                "tier": tier, "chunks": chunks}],
+                              on_tier=tier)
+            for wc in (val or {}).get("warm_chunks") or ():
+                self._warm_set(lanes, width).update(
+                    int(c) for c in wc or ())
+            self._warm_set(lanes, width).add(_WORKER_WARM)
+        elif self._batch_runner is None:
+            cm = self._tier_device(tier) if tier else None
+            with (cm if cm is not None else contextlib.nullcontext()):
+                sym = self._explore_batch(-1, [], [], lanes, width)
+                self._harvest_batch(-1, sym)
+        self._event("prewarm_bucket", tier=tier or "",
+                    width=width, lanes=lanes, chunks=len(chunks))
+        self._store_record(lanes, width)
+
+    def prewarm_from_store(self, limit: Optional[int] = None,
+                           should_stop=None) -> Dict:
+        """Replay the registry's hottest buckets for the active tier
+        ahead of traffic (daemon start, worker respawn, tier
+        re-promotion). Strictly subordinate to live work: the caller's
+        ``should_stop`` is consulted between buckets, and a stop leaves
+        ``_prewarm_pending`` set so the background loop resumes later.
+        A single bucket failure degrades to lazy compile for that
+        bucket (loud ``prewarm_failed`` event, never an abort); a
+        crash-looping worker (breaker open) stops the whole pass —
+        hammering a broken backend with compile work helps nobody.
+        Returns (and stores, for ``/healthz``) the status doc."""
+        st = self._prewarm_state
+        store = self._compile_store
+        if store is None:
+            return dict(st)
+        self._prewarm_pending = False
+        tier = self._active_tier()
+        buckets = store.buckets(tier=tier, cfh=self._store_cfh)
+        if limit is not None:
+            buckets = buckets[:limit]
+        st.update({"state": "running", "done": 0, "total": len(buckets),
+                   "last_error": None, "tier": tier})
+        if buckets:
+            self._event("prewarm_started", tier=tier,
+                        buckets=len(buckets))
+        stopped = False
+        for b in buckets:
+            if should_stop is not None and should_stop():
+                self._prewarm_pending = True  # resume when idle again
+                stopped = True
+                break
+            try:
+                self.prewarm_bucket(b)
+                st["done"] += 1
+                obs_metrics.REGISTRY.counter(
+                    "prewarm_buckets_total",
+                    help="registry buckets AOT-prewarmed").inc()
+            except WorkerCrashLoop as e:
+                st["last_error"] = str(e)[:300]
+                self._event("prewarm_failed", detail=str(e)[:300],
+                            tier=tier, terminal=True)
+                obs_metrics.REGISTRY.counter(
+                    "prewarm_failures_total",
+                    help="prewarm buckets that degraded to lazy "
+                         "compile").inc()
+                break
+            except Exception as e:  # noqa: BLE001 — degrade to lazy compile
+                st["last_error"] = str(e)[:300]
+                self._event("prewarm_failed", detail=str(e)[:300],
+                            tier=tier, terminal=False)
+                obs_metrics.REGISTRY.counter(
+                    "prewarm_failures_total",
+                    help="prewarm buckets that degraded to lazy "
+                         "compile").inc()
+        st["state"] = ("yielded" if stopped else
+                       "failed" if st["last_error"] else "done")
+        if buckets and not stopped:
+            self._event("prewarm_done", tier=tier, done=st["done"],
+                        total=st["total"])
+        return dict(st)
+
+    def prewarm_status(self) -> Dict:
+        """The ``/healthz`` ``prewarm`` doc: state, buckets done/total,
+        last error."""
+        return dict(self._prewarm_state)
+
     def _harvest_batch(self, bi: int, sym) -> Dict:
         """HOST phase of one batch: detection modules + witness search +
         report merge over a finished exploration. Pure host work (the
@@ -671,6 +864,7 @@ class CorpusCampaign:
         if acc is not None:
             acc["device"] += dv.dur or 0.0
             acc["host"] += hp.dur or 0.0
+        self._store_record(lanes, width)
         return out
 
     # --- supervised engine worker (docs/resilience.md) ------------------
@@ -690,6 +884,10 @@ class CorpusCampaign:
         if kind == "worker_death":
             for s in self._warm_shapes.values():
                 s.discard(_WORKER_WARM)
+        if kind == "worker_restart":
+            # a fresh worker process compiles cold (modulo the shared
+            # persistent cache): flag the background prewarm loop
+            self._prewarm_pending = True
         self._event(kind, detail=detail, **kw)
 
     def _worker_config(self) -> Dict:
@@ -761,7 +959,14 @@ class CorpusCampaign:
             h = float((ph or {}).get("host") or 0.0)
             acc["host"] += h
             acc["device"] += max(0.0, wall - h)
+        # chunk ints the worker compiled through the shared persistent
+        # cache: fleet-warm (they outlive the worker process), so they
+        # join the shape class's warm set and the registry bucket
+        wc = out.pop("warm_chunks", None) if isinstance(out, dict) \
+            else None
+        self._warm_set(lanes, width).update(int(c) for c in wc or ())
         self._warm_set(lanes, width).add(_WORKER_WARM)
+        self._store_record(lanes, width)
         return out
 
     def worker_status(self) -> Optional[Dict]:
@@ -910,6 +1115,11 @@ class CorpusCampaign:
             self.close_worker()
             self._event("tier_applied", tier=tm.current,
                         generation=tm.generation)
+            # the tier the campaign now holds compiles cold by design
+            # (the invalidation above is correct — those executables
+            # belonged to the previous backend); the compile store can
+            # make the recovery cheap, so flag the prewarm loop
+            self._prewarm_pending = True
         return tm.current if tm.demoted() else None
 
     def _floor_tier(self) -> str:
@@ -1299,6 +1509,15 @@ class CorpusCampaign:
         tk = ""
         if tier is not None:
             tk = f" tier={tier}" + ("!" if self._tm.demoted() else "")
+        # compile-warmth token (docs/serving.md "Compile artifacts &
+        # prewarm"): shape classes warm in THIS process / registry
+        # buckets recorded for the active tier ("warm 2/5" = three
+        # buckets would still compile cold here)
+        warm_a, warm_b = self.warm_counts()
+        wa = ""
+        if warm_a or warm_b:
+            wa = f" warm {warm_a}/" + ("-" if warm_b is None
+                                       else str(warm_b))
         # serving token: end-to-end request latency percentiles from
         # the serve_request_seconds histogram — SLO drift on the same
         # line the operator already watches, no /metrics scrape needed
@@ -1314,7 +1533,7 @@ class CorpusCampaign:
               f"{len(self.contracts)} c/min {cpm:.1f} paths/s {pps:.1f} "
               f"frontier {100.0 * occ:.0f}% rung {rung} "
               f"z3-avoid {z3av:.0f}% "
-              f"ckpt-age {age_s}{wk}{tk}{rq}",
+              f"ckpt-age {age_s}{wk}{tk}{wa}{rq}",
               file=sys.stderr, flush=True)
         obs_trace.event("heartbeat", batch=done, batches_total=total,
                         contracts=contracts,
@@ -1329,6 +1548,7 @@ class CorpusCampaign:
                         worker_breaker=(wst["breaker"]
                                         if wst is not None else None),
                         tier=tier,
+                        warm_shapes=warm_a, warm_buckets=warm_b,
                         req_p50=(round(req_p50, 4)
                                  if req_p50 is not None else None),
                         req_p95=(round(req_p95, 4)

@@ -693,6 +693,7 @@ class WorkerSupervisor:
                            "by the supervisor").inc()
         self._event("worker_death", detail=detail, rc=rc,
                     deaths_in_window=len(self._deaths))
+        self._flag_cache_dirty()
         self._reap()
         if self._breaker_opened is not None:
             # the half-open probe died: re-open for a fresh cooldown
@@ -712,6 +713,25 @@ class WorkerSupervisor:
                                "path")
             self._gauge("engine_worker_breaker_open",
                         help="1 while the crash-loop breaker is open").set(1)
+
+    def _flag_cache_dirty(self) -> None:
+        """Drop the ``.dirty`` marker into the shared XLA cache dir (if
+        one is configured): this worker died uncleanly, so it may have
+        left a torn cache entry behind — the NEXT engine spawn probes
+        the cache before trusting it (engine_worker._maybe_probe_cache)
+        instead of segfaulting on a poisoned read. Best-effort: a
+        missing marker just means no probe, which was the status quo."""
+        cache = (self.worker_env.get("MYTHRIL_WORKER_JAX_CACHE")
+                 or os.environ.get("MYTHRIL_WORKER_JAX_CACHE"))
+        if not cache or not os.path.isdir(cache):
+            return
+        from .engine_worker import CACHE_DIRTY_MARKER
+
+        try:
+            with open(os.path.join(cache, CACHE_DIRTY_MARKER), "w") as fh:
+                fh.write(f"pid={os.getpid()} t={time.time():.3f}\n")
+        except OSError:
+            pass
 
     def _note_success(self) -> None:
         self._consecutive = 0
@@ -998,6 +1018,54 @@ class WorkerSupervisor:
             value = rep["value"]
             if isinstance(value, dict):
                 self._absorb_telemetry(value.pop("telemetry", None), bi)
+            return value
+
+    def prewarm(self, buckets: Sequence[Dict],
+                on_tier: Optional[str] = None) -> Dict:
+        """AOT-prewarm a list of shape buckets in the worker (the
+        compile-store recovery path, docs/serving.md "Compile artifacts
+        & prewarm"). Same lifecycle discipline as :meth:`run_batch` —
+        breaker check, spawn-on-demand, parent-side deadline (the spawn
+        timeout: a prewarm is all compile, which is exactly what that
+        budget was sized for), death accounting — so a wedged prewarm
+        can never outlive its budget and a crashy one trips the same
+        breaker live batches do. Returns the worker's ``{done, total}``
+        reply; raises the same typed errors as ``run_batch``."""
+        buckets = [dict(b) for b in buckets]
+        with self._lock:
+            self._check_breaker()
+            if not self.alive():
+                self._spawn_and_init()
+            deadline = time.monotonic() + self.spawn_timeout
+            from .obs import trace as obs_trace
+
+            try:
+                self._send({"op": "prewarm", "buckets": buckets,
+                            "on_tier": on_tier,
+                            "trace": obs_trace.context_snapshot()})
+                rep = self._read_frame(deadline)
+            except TimeoutError:
+                self._record_death(
+                    f"prewarm ({len(buckets)} buckets) exceeded "
+                    f"{self.spawn_timeout:.0f}s; worker killed")
+                raise BatchTimeout(
+                    f"prewarm exceeded {self.spawn_timeout:.0f}s "
+                    "wall-clock budget in the engine worker (worker "
+                    "killed)") from None
+            except (EOFError, OSError):
+                rc = self._exit_code()
+                self._record_death(f"worker died mid-prewarm (rc={rc})")
+                raise WorkerDied(
+                    f"engine worker died mid-prewarm (rc={rc})"
+                ) from None
+            if not rep.get("ok"):
+                self._note_success()
+                raise self._rehydrate(rep)
+            self._note_success()
+            self._update_rss()
+            value = rep["value"]
+            if isinstance(value, dict):
+                self._absorb_telemetry(value.pop("telemetry", None), -1)
             return value
 
 

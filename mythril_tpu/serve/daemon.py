@@ -140,7 +140,9 @@ class AnalysisDaemon:
                  backfill_poll: float = 2.0,
                  compact_every: Optional[float] = None,
                  store_only: bool = False,
-                 store_refresh: float = 2.0):
+                 store_refresh: float = 2.0,
+                 compile_store: Optional[str] = "auto",
+                 prewarm: bool = True):
         if store_only:
             # an edge replica has no engine: it cannot host a fleet,
             # tail the chain, backfill history, or serve without the
@@ -194,6 +196,20 @@ class AnalysisDaemon:
         self.store_refresh = max(0.05, float(store_refresh))
         self._bg_stop = threading.Event()
         self._bg_threads: List[threading.Thread] = []
+        # fleet compile-artifact store + AOT prewarm (docs/serving.md
+        # "Compile artifacts & prewarm"): "auto" puts the registry +
+        # shared XLA cache under the data dir so sibling/restarted
+        # replicas share it; None disables. A store-only replica has
+        # no engine and therefore nothing to compile. Created lazily
+        # in start() — the compilestore import chain reaches jax, and
+        # the daemon constructor stays backend-free.
+        if compile_store == "auto":
+            compile_store = (None if store_only
+                             else os.path.join(data_dir, "compile_store"))
+        self.compile_store_dir = compile_store
+        self.compile_store = None
+        self.prewarm = bool(prewarm) and compile_store is not None
+        self._prewarm_doc: Optional[Dict] = None
         if store_only:
             self.scheduler = StoreOnlyScheduler()
         else:
@@ -263,6 +279,17 @@ class AnalysisDaemon:
         tiers = self.scheduler.tier_status()
         if tiers:
             doc["backend_tiers"] = tiers
+        # compile-artifact prewarm state (docs/serving.md "Compile
+        # artifacts & prewarm"): what the background pass did / is
+        # doing, so an orchestrator can tell "came back warm" from
+        # "still compiling lazily"
+        if self.compile_store_dir and not self.store_only:
+            doc["prewarm"] = (dict(self._prewarm_doc)
+                              if self._prewarm_doc is not None
+                              else {"state": ("pending" if self.prewarm
+                                              else "disabled"),
+                                    "done": 0, "total": 0,
+                                    "last_error": None})
         if self.follower is not None:
             doc["follower"] = self.follower.status()
         if self.backfill is not None:
@@ -302,6 +329,15 @@ class AnalysisDaemon:
 
             self._prev_solver_store = smt_portfolio.set_store(
                 self.solver_store)
+        if self.compile_store_dir and not self.store_only:
+            from ..compilestore import CompileStore
+
+            self.compile_store = CompileStore(self.compile_store_dir)
+            # point the worker-cache contract at the shared dir BEFORE
+            # any campaign spawns a worker (setdefault: an operator /
+            # test-pinned MYTHRIL_WORKER_JAX_CACHE wins)
+            self.compile_store.install_cache()
+            self.scheduler.compile_store = self.compile_store
         self.scheduler.start()
         self.httpd = ServeHTTPServer((self.host, self._port), self)
         self._http_thread = threading.Thread(
@@ -335,6 +371,11 @@ class AnalysisDaemon:
                                  daemon=True, name="serve-refresher")
             t.start()
             self._bg_threads.append(t)
+        if self.prewarm and self.compile_store is not None:
+            t = threading.Thread(target=self._prewarm_loop,
+                                 daemon=True, name="serve-prewarm")
+            t.start()
+            self._bg_threads.append(t)
         obs_trace.event("serve_started", host=self.host, port=self.port,
                         data_dir=self.data_dir)
         log.info("serving on %s:%d (data dir %s)", self.host, self.port,
@@ -358,6 +399,64 @@ class AnalysisDaemon:
                          "(retried next period)").inc()
                 log.warning("compaction failed: %s: %s",
                             type(e).__name__, str(e)[:200])
+
+    def _prewarm_loop(self) -> None:
+        """Background AOT prewarm (docs/serving.md "Compile artifacts
+        & prewarm"): on daemon start, materialize the baseline config's
+        resident campaign and replay the registry's hottest buckets for
+        its tier; afterwards, poll for recovery events — a worker
+        respawn or a tier re-promotion flags ``_prewarm_pending`` on
+        its campaign — and re-prewarm. Strictly subordinate to live
+        traffic: the pass yields between buckets whenever the queue has
+        work (or the daemon is draining), so a submitted request is
+        scheduled without waiting for prewarm completion. Every failure
+        here degrades to lazy compile — this thread may never take the
+        daemon down."""
+
+        def busy() -> bool:
+            if self._bg_stop.is_set():
+                return True
+            try:
+                return self.queue.stats()["queue_depth"] > 0
+            except Exception:  # noqa: BLE001 — err on yielding
+                return True
+
+        try:
+            from .store import config_hash
+
+            cfg = self.options.effective({})
+            camp = self.scheduler.campaign_for_config(cfg,
+                                                      config_hash(cfg))
+        except Exception as e:  # noqa: BLE001 — degrade to lazy compile
+            self._prewarm_doc = {"state": "failed", "done": 0,
+                                 "total": 0,
+                                 "last_error": f"{type(e).__name__}: "
+                                               f"{str(e)[:200]}"}
+            log.warning("prewarm: baseline campaign unavailable: %s", e)
+            return
+        first = True
+        while not self._bg_stop.is_set():
+            for camp in list(self.scheduler._campaigns.values()):
+                if self._bg_stop.is_set():
+                    break
+                # a factory-injected stand-in campaign (tests, custom
+                # embedders) may not speak the prewarm protocol
+                if not hasattr(camp, "prewarm_from_store"):
+                    continue
+                if not (first or getattr(camp, "_prewarm_pending",
+                                         False)):
+                    continue
+                try:
+                    self._prewarm_doc = camp.prewarm_from_store(
+                        should_stop=busy)
+                except Exception as e:  # noqa: BLE001 — lazy compile
+                    self._prewarm_doc = {
+                        "state": "failed", "done": 0, "total": 0,
+                        "last_error": f"{type(e).__name__}: "
+                                      f"{str(e)[:200]}"}
+                    log.warning("prewarm pass failed: %s", e)
+            first = False
+            self._bg_stop.wait(0.25)
 
     def _refresh_loop(self) -> None:
         """Store-only replica poll: pick up manifest generations

@@ -29,10 +29,16 @@ first-wins (``ResultsStore.put`` via ``exclusive_write``), so two
 replicas racing the same ``(bytecode, config)`` commit exactly one
 file and the loser's copy is dropped (equal by construction) — each
 replica still resolves its own waiters from its own batch result. The
-warm-shape registry and in-flight dedupe index are deliberately
-process-local: warmth is an XLA-cache property of one process, and
-cross-replica dedupe happens through the shared store the moment the
-first replica commits.
+in-flight dedupe index is deliberately process-local: cross-replica
+dedupe happens through the shared store the moment the first replica
+commits. The warm-shape registry is process-local too (warmth is an
+XLA-cache property of one process), but since PR 20 it is no longer a
+process-local *accident*: with a compile store attached
+(mythril_tpu/compilestore.py), warm observations are recorded durably
+per (tier, shape-class, config-hash) bucket and replayed by the
+daemon's prewarm thread, so a restarted or sibling replica re-acquires
+warmth from the shared persistent cache instead of recompiling
+(docs/serving.md "Compile artifacts & prewarm").
 """
 
 from __future__ import annotations
@@ -104,18 +110,31 @@ class Scheduler:
                  batch_size: int = 8,
                  poll: float = 0.25,
                  fleet_dir: Optional[str] = None,
-                 campaign_factory: Optional[Callable] = None):
+                 campaign_factory: Optional[Callable] = None,
+                 compile_store=None):
         self.queue = queue
         self.store = store
         self.batch_size = max(1, int(batch_size))
         self.poll = max(0.02, float(poll))
         self.fleet_dir = fleet_dir
         self.campaign_factory = campaign_factory or default_campaign_factory
+        #: fleet compile-artifact store (mythril_tpu/compilestore.py):
+        #: when set, every resident campaign records its warm shapes
+        #: durably and the daemon's prewarm thread can replay them —
+        #: this is what retires the "warmth is process-local" caveat
+        #: in the module docstring for RECOVERY (in-process warmth is
+        #: still per-process; the registry + shared persistent cache
+        #: make re-acquiring it cheap)
+        self.compile_store = compile_store
         #: one resident campaign per effective config (cfh); all share
         #: the warm-shape registry below, so config variants of one
         #: ENGINE shape class (same width/lanes/steps/tx, e.g. a
         #: different module list) still count as warm
         self._campaigns: Dict[str, object] = {}
+        #: guards campaign get-or-create: the daemon's prewarm thread
+        #: may materialize the baseline campaign while the loop creates
+        #: one for the first request — exactly one instance must win
+        self._camp_lock = threading.Lock()
         self._warm_shapes: Dict[tuple, set] = {}
         self._ledger = None
         #: fleet mode: fed-but-uncommitted units -> their entries
@@ -262,17 +281,30 @@ class Scheduler:
             self._pending.clear()
 
     # --- local (resident-campaign) execution ----------------------------
+    def campaign_for_config(self, config: Dict, cfh: str):
+        """Get-or-create the resident campaign for one effective
+        config. Public so the daemon's background prewarm thread can
+        materialize (and warm) the baseline config's campaign before
+        the first request ever arrives."""
+        with self._camp_lock:
+            camp = self._campaigns.get(cfh)
+            if camp is None:
+                camp = self.campaign_factory(config)
+                # one warm-shape registry across every resident
+                # campaign: sym_run's XLA cache is process-wide, so
+                # warmth is a process property, not a per-config one
+                if hasattr(camp, "_warm_shapes"):
+                    camp._warm_shapes = self._warm_shapes
+                if (self.compile_store is not None
+                        and hasattr(camp, "attach_compile_store")):
+                    self.compile_store.install_cache()
+                    camp.attach_compile_store(self.compile_store,
+                                              cfh=cfh)
+                self._campaigns[cfh] = camp
+            return camp
+
     def _campaign_for(self, e: Entry):
-        camp = self._campaigns.get(e.cfh)
-        if camp is None:
-            camp = self.campaign_factory(e.config)
-            # one warm-shape registry across every resident campaign:
-            # sym_run's XLA cache is process-wide, so warmth is a
-            # process property, not a per-config one
-            if hasattr(camp, "_warm_shapes"):
-                camp._warm_shapes = self._warm_shapes
-            self._campaigns[e.cfh] = camp
-        return camp
+        return self.campaign_for_config(e.config, e.cfh)
 
     def _run_batch(self, entries: List[Entry]) -> None:
         camp = self._campaign_for(entries[0])
@@ -458,6 +490,28 @@ class Scheduler:
                 n += int(st.get("restarts", 0))
         return n
 
+    def warm_counts(self) -> tuple:
+        """``(warm shape classes in this process, registry buckets)``
+        for the serve heartbeat's ``warm a/b`` token. Prefers a
+        resident campaign's tier-scoped count (its registry view is
+        filtered to the tier it holds); a campaign-less daemon falls
+        back to the store-wide bucket count. ``None`` second element =
+        no compile store attached."""
+        a = sum(1 for s in self._warm_shapes.values() if s)
+        if self.compile_store is None:
+            return a, None
+        for camp in list(self._campaigns.values()):
+            wc = getattr(camp, "warm_counts", None)
+            if callable(wc):
+                try:
+                    return wc()
+                except Exception:  # noqa: BLE001 — heartbeat decoration
+                    break
+        try:
+            return a, len(self.compile_store.buckets())
+        except Exception:  # noqa: BLE001 — registry scan is best-effort
+            return a, 0
+
     # --- backend-tier surface (docs/resilience.md "Backend tiers") ------
     def tier_status(self) -> List[Dict]:
         """Per-config backend-tier ladder state: which capacity class
@@ -510,6 +564,9 @@ class StoreOnlyScheduler:
 
     def tier_status(self) -> List[Dict]:
         return []
+
+    def warm_counts(self) -> tuple:
+        return 0, None
 
 
 __all__ = ["Scheduler", "StoreOnlyScheduler", "default_campaign_factory"]

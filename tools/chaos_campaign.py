@@ -58,6 +58,24 @@ Injection points (columns):
                        the durable two-ended cursor (re-ingesting
                        nothing already committed) and converge on one
                        stored verdict per historical contract
+  kill-mid-registry-write  os._exit(9) a compile-store registry writer
+                       at each protocol point (pre-write / post-write
+                       / torn-write); after EVERY kill the bucket must
+                       stay readable — a torn newest quarantined
+                       ``.corrupt`` with the rotated copy served — and
+                       the next observation must heal it
+  corrupt-cache-quarantine  a poisoned persistent XLA cache flagged
+                       ``.dirty`` by an unclean worker death; the
+                       probe subprocess dies (SIGSEGV) in the worker's
+                       place, the whole dir is set aside ``.corrupt``
+                       (evidence preserved, never a silent wipe), and
+                       the campaign completes cold on a fresh dir
+  tier-flap-during-prewarm  a flapping device mid-campaign while the
+                       registry prewarm pass brackets it: the pass
+                       yields to live traffic (re-arming itself), the
+                       flap's re-promotion re-arms it again, and the
+                       settled tier replays its buckets — parity
+                       intact, prewarm never aborts the campaign
 
 Modes (rows): ``batch`` (serial campaign), ``pipelined`` (depth-1
 pipeline), ``fleet`` (work-ledger campaign), ``serve`` (in-process
@@ -113,6 +131,8 @@ MATRIX: Dict[str, Tuple[str, ...]] = {
              "tier-flap"),
     "store": ("kill-mid-compaction", "torn-segment",
               "kill-mid-backfill-window"),
+    "compile": ("kill-mid-registry-write", "corrupt-cache-quarantine",
+                "tier-flap-during-prewarm"),
 }
 
 N = 6  # distinct bytecodes (serve dedupe would collapse clones)
@@ -892,6 +912,168 @@ def _cell_backfill_kill(d: str, contracts, baseline: List[str]) -> Dict:
     return cell
 
 
+#: one compile-store registry observation, run in a subprocess so the
+#: armed kill point takes out a separate writer, not the matrix
+_COMPILE_RECORD_SRC = """\
+import sys
+from mythril_tpu.compilestore import CompileStore
+CompileStore(sys.argv[1]).record(
+    "cpu", (2, 8, 64, 1), "deadbeefcafe0000", chunks=(16, 32))
+print("RECORDED")
+"""
+
+
+def _compile_record(root: str, kill: Optional[str] = None) -> int:
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MYTHRIL_COMPILESTORE_KILL", None)
+    if kill:
+        env["MYTHRIL_COMPILESTORE_KILL"] = kill
+    r = subprocess.run(
+        [sys.executable, "-c", _COMPILE_RECORD_SRC, root],
+        capture_output=True, text=True, env=env, cwd=ROOT)
+    return r.returncode
+
+
+def _cell_compile_kill_registry(d: str, contracts,
+                                baseline: List[str]) -> Dict:
+    """Die (os._exit, SIGKILL-equivalent) at each point of the compile
+    registry's write protocol. After EVERY kill the bucket must read
+    back whole — the torn-write point leaves a half-written newest
+    that the reader must quarantine ``.corrupt`` and answer from the
+    rotated copy — and one more observation must heal the bucket to a
+    clean durable record (docs/serving.md "Compile artifacts &
+    prewarm")."""
+    from mythril_tpu.compilestore import CompileStore
+
+    root = os.path.join(d, "cstore")
+    seed_rc = _compile_record(root)    # create path (first-wins link)
+    merge_rc = _compile_record(root)   # merge path (rotates a .1 copy)
+    kills: Dict[str, int] = {}
+    readable: Dict[str, bool] = {}
+    for point in ("pre-write", "post-write", "torn-write"):
+        kills[point] = _compile_record(root, kill=point)
+        bks = CompileStore(root).buckets()
+        readable[point] = (len(bks) == 1
+                           and bks[0]["tier"] == "cpu"
+                           and bks[0]["hits"] >= 1
+                           and bks[0]["chunks"] == [16, 32])
+    heal_rc = _compile_record(root)
+    stats = CompileStore(root).stats()
+    cell = {"kills": kills, "readable": readable,
+            "heal_rc": heal_rc, "stats": stats}
+    cell["ok"] = (seed_rc == 0 and merge_rc == 0
+                  and all(rc == 9 for rc in kills.values())
+                  and all(readable.values())
+                  # the torn newest was set aside, not silently eaten
+                  and stats.get("corrupt_quarantined", 0) >= 1
+                  and heal_rc == 0
+                  and stats.get("buckets") == 1)
+    return cell
+
+
+def _cell_compile_cache_quarantine(d: str, contracts,
+                                   baseline: List[str]) -> Dict:
+    """A poisoned persistent XLA cache, flagged ``.dirty`` by a prior
+    unclean worker death: the probe compile (forced to SIGSEGV by the
+    chaos hook, as a torn cache entry would) must die in a THROWAWAY
+    subprocess, the whole dir must be set aside ``.corrupt`` with its
+    contents preserved, and the campaign must complete cold on a
+    fresh dir — never a worker segfault, never a silent wipe."""
+    cache = os.path.join(d, "xla_cache")
+    os.makedirs(cache)
+    with open(os.path.join(cache, "entry-0"), "wb") as fh:
+        fh.write(b"\x00poisoned-xla-entry")
+    with open(os.path.join(cache, ".dirty"), "w") as fh:
+        fh.write("pid=0 t=0\n")
+    saved = {k: os.environ.get(k) for k in
+             ("MYTHRIL_WORKER_JAX_CACHE", "MYTHRIL_CACHE_PROBE_FAULT")}
+    os.environ["MYTHRIL_WORKER_JAX_CACHE"] = cache
+    os.environ["MYTHRIL_CACHE_PROBE_FAULT"] = "segv"
+    try:
+        res = _campaign(contracts, os.path.join(d, "ck"),
+                        worker_isolation="on").run()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    quarantined = sorted(f for f in os.listdir(d)
+                         if f.startswith("xla_cache.corrupt"))
+    evidence = any(
+        os.path.exists(os.path.join(d, q, "entry-0"))
+        for q in quarantined)
+    kinds = _worker_kinds(res.backend_events)
+    cell = {"issues": _issues(res), "retries": res.retries,
+            "quarantined_dirs": quarantined, "evidence": evidence,
+            "worker_events": kinds,
+            "contracts_quarantined": [q["name"]
+                                      for q in res.quarantined]}
+    cell["ok"] = (cell["issues"] == baseline
+                  and len(res.issues) == len(baseline)
+                  and not res.quarantined
+                  and bool(quarantined) and evidence
+                  # the fresh dir took the poisoned one's place
+                  and os.path.isdir(cache)
+                  and not os.path.exists(
+                      os.path.join(cache, ".dirty"))
+                  # the worker never died: the probe took the hit
+                  and kinds.count("worker_death") == 0)
+    return cell
+
+
+def _cell_compile_flap_prewarm(d: str, contracts,
+                               baseline: List[str]) -> Dict:
+    """The registry prewarm pass bracketing a flapping device. Before
+    the campaign: a pass preempted by live traffic must YIELD and
+    re-arm itself, and an uncontended pass must replay the active
+    tier's buckets. During: the flap's re-promotion must re-arm the
+    pass (the recovered tier comes back warm, ISSUE 20's trigger).
+    After: the settled tier's pass must converge — with issue parity
+    and exactly-once accounting untouched by any of it."""
+    from mythril_tpu.compilestore import CompileStore
+    from mythril_tpu.resilience import FaultInjector
+
+    store = CompileStore(os.path.join(d, "cstore"))
+    tm = _tier_tm(probe_ok=True, flap_window=3600.0, flap_max=4)
+    camp = _campaign(contracts, os.path.join(d, "ck"),
+                     worker_isolation="off",
+                     fault_injector=FaultInjector.from_string("flap"),
+                     tier_manager=tm)
+    camp.attach_compile_store(store)
+    # seed both rungs of the ladder, as a prior daemon generation
+    # would have (batch shape: 2 contracts x 8 lanes x 64 x 1)
+    for tier in ("tpu", "cpu"):
+        store.record(tier, (2, 8, 64, 1), camp.semantic_hash(),
+                     chunks=(16,))
+    yielded = camp.prewarm_from_store(should_stop=lambda: True)
+    rearmed_after_yield = camp._prewarm_pending
+    first = camp.prewarm_from_store()
+    res = camp.run()
+    rearmed_by_flap = camp._prewarm_pending
+    second = camp.prewarm_from_store()
+    st = tm.status()
+    cell = {"issues": _issues(res), "retries": res.retries,
+            "yielded": yielded, "first_pass": first,
+            "second_pass": second, "tier": st,
+            "rearmed_after_yield": rearmed_after_yield,
+            "rearmed_by_flap": rearmed_by_flap}
+    cell["ok"] = (cell["issues"] == baseline
+                  and len(res.issues) == len(baseline)
+                  and not res.quarantined
+                  and yielded.get("state") == "yielded"
+                  and rearmed_after_yield
+                  and first.get("state") == "done"
+                  and first.get("done", 0) >= 1
+                  and st["repromotions"] >= 1
+                  and rearmed_by_flap
+                  and second.get("state") == "done"
+                  and second.get("done", 0) >= 1)
+    return cell
+
+
 def run_cell(mode: str, point: str, contracts,
              baseline: List[str]) -> Dict:
     with tempfile.TemporaryDirectory() as d:
@@ -921,6 +1103,13 @@ def run_cell(mode: str, point: str, contracts,
             return _cell_store_torn_segment(d, contracts, baseline)
         if mode == "store" and point == "kill-mid-backfill-window":
             return _cell_backfill_kill(d, contracts, baseline)
+        if mode == "compile" and point == "kill-mid-registry-write":
+            return _cell_compile_kill_registry(d, contracts, baseline)
+        if mode == "compile" and point == "corrupt-cache-quarantine":
+            return _cell_compile_cache_quarantine(d, contracts,
+                                                  baseline)
+        if mode == "compile" and point == "tier-flap-during-prewarm":
+            return _cell_compile_flap_prewarm(d, contracts, baseline)
         raise ValueError(f"cell {mode}:{point} is not in the matrix")
 
 

@@ -86,6 +86,14 @@ Five legs, one process (see docs/resilience.md + docs/checkpointing.md):
      edge replica on the same data dir must then answer the whole
      corpus from segments alone with issue parity and type the one
      unknown bytecode as ``unknown-contract`` instead of 500ing.
+ 15. coldstart — the fleet compile-artifact store across a HARD kill
+     (docs/serving.md "Compile artifacts & prewarm"): daemon A warms
+     a corpus and is SIGKILLed with no drain; daemon B on the same
+     data dir must AOT-prewarm from the durable shape-bucket registry
+     and answer a FRESH same-shape submission with
+     ``engine_compiles_total`` flat and
+     ``serve_warm_compile_hits_total`` rising — the recovered replica
+     comes back warm, the cold-start cliff is gone.
 
 Prints ONE JSON line {"ok": bool, "legs": {...}} and exits 0/1 —
 suitable as a CI smoke or a manual post-change sanity run:
@@ -144,7 +152,7 @@ N = 6  # even indices killable -> expected issues c000/c002/c004
 
 LEGS = ("transient", "poison", "kill_resume", "oom", "torn", "telemetry",
         "pipeline", "fleet", "serve", "solver_store", "chaos",
-        "replicas", "tiers", "segments")
+        "replicas", "tiers", "segments", "coldstart")
 
 
 def write_corpus(d: str) -> str:
@@ -885,6 +893,104 @@ def main() -> int:
                  ("fleet", "torn-ledger")])
             legs["chaos"] = out
             ok &= bool(out.get("ok"))
+
+        if "coldstart" in want:
+            # leg 15: the compile-artifact store across a HARD kill
+            # (docs/serving.md "Compile artifacts & prewarm"). Daemon A
+            # warms the corpus and is SIGKILLed — no drain, no
+            # persist-on-exit; only the durable registry + shared XLA
+            # cache survive. Daemon B on the same data dir must prewarm
+            # from the registry and reach its first verdict with
+            # engine_compiles_total FLAT and serve_warm_compile_hits
+            # rising: the recovered replica came back warm.
+            import re as _re
+            import signal
+            import time as _time
+
+            sys.path.insert(0, os.path.join(ROOT, "tools"))
+            import chaos_campaign
+            import serve_client
+
+            contracts = [
+                (f"w{i:03d}",
+                 assemble(i, "SELFDESTRUCT") if i % 2 == 0
+                 else assemble(1, i, "SSTORE", "STOP"))
+                for i in range(N)]
+            dd = os.path.join(d, "coldstart_data")
+            pa, url_a = chaos_campaign._start_replica(d, "cs_a", dd)
+            try:
+                warmup = serve_client.get_result(
+                    url_a, serve_client.submit(url_a, contracts,
+                                               tenant="soak")["id"],
+                    wait=600.0)
+            finally:
+                pa.send_signal(signal.SIGKILL)
+                rc_a = pa.wait(timeout=120)
+            bdir = os.path.join(dd, "compile_store", "buckets")
+            buckets_on_disk = (
+                len([f for f in os.listdir(bdir)
+                     if f.endswith(".json")])
+                if os.path.isdir(bdir) else 0)
+
+            pb, url_b = chaos_campaign._start_replica(d, "cs_b", dd)
+            prewarm: dict = {}
+            try:
+                deadline = _time.monotonic() + 300
+                while _time.monotonic() < deadline:
+                    try:
+                        prewarm = (serve_client.healthz(url_b)
+                                   .get("prewarm") or prewarm)
+                    except OSError:
+                        pass
+                    if prewarm.get("state") in ("done", "failed",
+                                                "disabled"):
+                        break
+                    _time.sleep(0.25)
+                met0 = serve_client.metrics(url_b)
+                # fresh bytecodes, same shape class: dedupe can't
+                # answer them — only a warm engine can skip compiles
+                fresh = [("f000", assemble(100, "SELFDESTRUCT")),
+                         ("f001", assemble(1, 100, "SSTORE", "STOP"))]
+                first = serve_client.get_result(
+                    url_b, serve_client.submit(url_b, fresh,
+                                               tenant="soak")["id"],
+                    wait=300.0)
+                met1 = serve_client.metrics(url_b)
+            finally:
+                pb.send_signal(signal.SIGTERM)
+                pb.wait(timeout=120)
+
+            def _met(text, name):
+                m = _re.search(r"^mythril_%s (\d+)" % name, text,
+                               _re.MULTILINE)
+                return int(m.group(1)) if m else 0
+
+            compiles = [_met(met0, "engine_compiles_total"),
+                        _met(met1, "engine_compiles_total")]
+            warm_hits = [_met(met0, "serve_warm_compile_hits_total"),
+                         _met(met1, "serve_warm_compile_hits_total")]
+            issues = sorted(i["contract"] for r in first["results"]
+                            for i in (r.get("issues") or []))
+            legs["coldstart"] = {
+                "warmup_state": warmup["state"], "kill_rc": rc_a,
+                "buckets_on_disk": buckets_on_disk,
+                "prewarm": prewarm, "engine_compiles": compiles,
+                "warm_hits": warm_hits, "issues": issues,
+            }
+            ok &= (warmup["state"] == "done"
+                   and warmup["completed"] == N
+                   and rc_a == -signal.SIGKILL
+                   and buckets_on_disk >= 1
+                   and prewarm.get("state") == "done"
+                   and prewarm.get("done", 0) >= 1
+                   and first["state"] == "done"
+                   and first["completed"] == 2
+                   # the restarted daemon's first verdict compiled
+                   # NOTHING: prewarm + the shared persistent cache
+                   # carried every artifact across the kill
+                   and compiles[1] == compiles[0]
+                   and warm_hits[1] > warm_hits[0]
+                   and issues == ["f000"])
 
     print(json.dumps({"ok": bool(ok), "legs": legs}))
     return 0 if ok else 1

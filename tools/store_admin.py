@@ -9,6 +9,13 @@ daemon needed, stdlib + serve-layer imports only (no jax, no engine):
     python tools/store_admin.py compact --store serve_data/store
     python tools/store_admin.py stats   --store serve_data/store
 
+and on a ``--data-dir``'s ``compile_store/`` directory (docs/serving.md
+"Compile artifacts & prewarm" — these two lazily import the engine-side
+checkpoint helpers, so jax comes along for the ride):
+
+    python tools/store_admin.py compile-stats --store serve_data/compile_store
+    python tools/store_admin.py compile-gc   --store serve_data/compile_store
+
 ``verify``   read-only integrity sweep: checksum every manifest-
              referenced segment (whole-file + per-record) and every
              loose verdict file; reports corruption, quarantines
@@ -22,6 +29,14 @@ daemon needed, stdlib + serve-layer imports only (no jax, no engine):
 ``stats``    generation number, per-segment key counts, loose tally,
              and the bytecode dedupe ratio (keys per distinct
              bytecode — how much clone/proxy dominance is saving).
+``compile-stats``  shape of the compile-artifact store: bucket/tier
+             counts, hit totals, quarantined corpses, XLA cache
+             footprint (read-only, safe on a live store).
+``compile-gc``     single-owner GC pass: evict cold buckets past the
+             cap/``--ttl``, sweep stale tmps + aged ``.corrupt``
+             quarantine files, prune XLA cache entries unused past
+             ``--cache-ttl``. Run it from the ONE host allowed to GC
+             a shared data dir (same ownership rule as ``compact``).
 
 Each subcommand prints one JSON document; importable functions
 (``cmd_verify`` / ``cmd_compact`` / ``cmd_stats``) are exercised by
@@ -104,6 +119,20 @@ def cmd_stats(store_dir: str) -> Dict:
     }
 
 
+def cmd_compile_stats(store_dir: str) -> Dict:
+    """Shape of the fleet compile-artifact store, read-only."""
+    from mythril_tpu.compilestore import CompileStore
+    return CompileStore(store_dir).stats()
+
+
+def cmd_compile_gc(store_dir: str, max_buckets=None, ttl=None,
+                   cache_ttl=None) -> Dict:
+    """One single-owner GC pass over registry + shared XLA cache."""
+    from mythril_tpu.compilestore import CompileStore
+    return CompileStore(store_dir).gc(
+        max_buckets=max_buckets, ttl=ttl, cache_ttl=cache_ttl)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -112,10 +141,31 @@ def main() -> int:
         p.add_argument("--store", required=True, metavar="DIR",
                        help="the store directory "
                             "(<data-dir>/store)")
+    for name in ("compile-stats", "compile-gc"):
+        p = sub.add_parser(name)
+        p.add_argument("--store", required=True, metavar="DIR",
+                       help="the compile-artifact store directory "
+                            "(<data-dir>/compile_store)")
+        if name == "compile-gc":
+            p.add_argument("--max-buckets", type=int, default=None,
+                           help="override the registry's recency cap "
+                                "for this pass")
+            p.add_argument("--ttl", type=float, default=None,
+                           help="evict buckets idle longer than this "
+                                "many seconds")
+            p.add_argument("--cache-ttl", type=float, default=None,
+                           help="prune XLA cache files unused longer "
+                                "than this many seconds")
     args = ap.parse_args()
-    fn = {"verify": cmd_verify, "compact": cmd_compact,
-          "stats": cmd_stats}[args.cmd]
-    out = fn(args.store)
+    if args.cmd == "compile-stats":
+        out = cmd_compile_stats(args.store)
+    elif args.cmd == "compile-gc":
+        out = cmd_compile_gc(args.store, max_buckets=args.max_buckets,
+                             ttl=args.ttl, cache_ttl=args.cache_ttl)
+    else:
+        fn = {"verify": cmd_verify, "compact": cmd_compact,
+              "stats": cmd_stats}[args.cmd]
+        out = fn(args.store)
     print(json.dumps(out, indent=1, sort_keys=True))
     if args.cmd == "verify" and not out["ok"]:
         return 1
